@@ -1,0 +1,15 @@
+"""bigdl_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up JAX/XLA re-design with the capabilities of the reference
+BigDL-on-Spark library (see SURVEY.md): Torch-style modules and criterions,
+composable data pipelines, synchronous data-parallel training with sharded
+parameter updates (ZeRO-1-style reduce-scatter/all-gather over ICI),
+optimizers/schedules/triggers/validation, checkpoint-resume-retry,
+TensorBoard event writing, and a model zoo — all built TPU-first on
+``jax.sharding`` meshes and ``jit``-compiled train steps.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.utils.engine import Engine  # noqa: F401
+from bigdl_tpu.utils.rng import RNG  # noqa: F401

@@ -1,0 +1,11 @@
+"""bigdl_tpu.nn — module/criterion layer (the reference's ``nn`` package,
+SURVEY §2.4-§2.5), re-designed for JAX."""
+
+from bigdl_tpu.nn.module import (  # noqa: F401
+    Module, Parameter, Container, Sequential, Identity, Echo,
+    LayerException, functional_call, state_dict, load_state_dict,
+)
+from bigdl_tpu.nn import init  # noqa: F401
+from bigdl_tpu.nn.criterion import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers.activation import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers.linear import *  # noqa: F401,F403

@@ -1,0 +1,538 @@
+"""Criterions (losses).
+
+Parity with the reference's criterion catalog (SURVEY §2.5; base class
+``nn/abstractnn/AbstractCriterion.scala``): ``forward(input, target)``
+computes the loss, ``backward(input, target)`` the input gradient.  Unlike
+the reference's hand-written ``updateGradInput`` per loss, backward here is
+``jax.grad`` of the pure forward — one definition, exact gradients.
+
+Label convention: the reference (Torch lineage) uses 1-based class labels;
+this framework is 0-based by default (idiomatic for a new Python/JAX API),
+with ``one_based=True`` available on classification losses for users porting
+reference pipelines.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Criterion",
+    "AbstractCriterion",
+    "ClassNLLCriterion",
+    "CrossEntropyCriterion",
+    "BCECriterion",
+    "MSECriterion",
+    "AbsCriterion",
+    "SmoothL1Criterion",
+    "SmoothL1CriterionWithWeights",
+    "DistKLDivCriterion",
+    "HingeEmbeddingCriterion",
+    "L1HingeEmbeddingCriterion",
+    "MarginCriterion",
+    "MarginRankingCriterion",
+    "MultiCriterion",
+    "ParallelCriterion",
+    "MultiLabelMarginCriterion",
+    "MultiLabelSoftMarginCriterion",
+    "MultiMarginCriterion",
+    "SoftMarginCriterion",
+    "L1Cost",
+    "CosineEmbeddingCriterion",
+    "CosineDistanceCriterion",
+    "ClassSimplexCriterion",
+    "DiceCoefficientCriterion",
+    "TimeDistributedCriterion",
+    "SoftmaxWithCriterion",
+]
+
+
+class Criterion:
+    """Loss base (``nn/abstractnn/AbstractCriterion.scala``)."""
+
+    def __init__(self):
+        self.output = None
+        self.grad_input = None
+        self.forward_time = 0.0
+        self.backward_time = 0.0
+
+    def update_output(self, input, target):
+        raise NotImplementedError
+
+    def forward(self, input, target):
+        t0 = time.perf_counter()
+        self.output = self.update_output(input, target)
+        self.forward_time += time.perf_counter() - t0
+        return self.output
+
+    __call__ = forward
+
+    def backward(self, input, target):
+        t0 = time.perf_counter()
+        self.grad_input = jax.grad(lambda x: jnp.sum(self.update_output(x, target)))(input)
+        self.backward_time += time.perf_counter() - t0
+        return self.grad_input
+
+    def clone_criterion(self):
+        return copy.deepcopy(self)
+
+
+AbstractCriterion = Criterion
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+def _to_index(target, one_based: bool):
+    t = jnp.asarray(target)
+    if t.dtype in (jnp.float32, jnp.float64, jnp.bfloat16):
+        t = t.astype(jnp.int32)
+    if one_based:
+        t = t - 1
+    return t
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probability input
+    (``nn/ClassNLLCriterion.scala``)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 log_prob_as_input: bool = True, one_based: bool = False):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.log_prob_as_input = log_prob_as_input
+        self.one_based = one_based
+
+    def update_output(self, input, target):
+        t = _to_index(target, self.one_based)
+        logp = input if self.log_prob_as_input else jnp.log(jnp.clip(input, 1e-8))
+        if logp.ndim == 1:
+            logp = logp[None, :]
+            t = jnp.reshape(t, (1,))
+        t = jnp.reshape(t, (logp.shape[0],))
+        picked = jnp.take_along_axis(logp, t[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = self.weights[t]
+            total = -jnp.sum(w * picked)
+            return total / jnp.sum(w) if self.size_average else total
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (``nn/CrossEntropyCriterion.scala``)."""
+
+    def __init__(self, weights=None, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.nll = ClassNLLCriterion(weights, size_average, True, one_based)
+
+    def update_output(self, input, target):
+        return self.nll.update_output(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class BCECriterion(Criterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1.0 - eps)
+        t = jnp.asarray(target, x.dtype)
+        loss = -(t * jnp.log(x) + (1.0 - t) * jnp.log(1.0 - x))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        return _reduce((input - jnp.asarray(target, input.dtype)) ** 2, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        return _reduce(jnp.abs(input - jnp.asarray(target, input.dtype)), self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        d = jnp.abs(input - jnp.asarray(target, input.dtype))
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Smooth-L1 with inside/outside weights (Fast-RCNN bbox loss,
+    ``nn/SmoothL1CriterionWithWeights.scala``). Target is a table
+    (target, inside_w, outside_w)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def update_output(self, input, target):
+        t, w_in, w_out = target
+        d = w_in * (input - t)
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * d * d,
+                         ad - 0.5 / self.sigma2)
+        total = jnp.sum(w_out * loss)
+        return total / self.num if self.num > 0 else total
+
+
+class DistKLDivCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        t = jnp.asarray(target, input.dtype)
+        loss = jnp.where(t > 0, t * (jnp.log(jnp.clip(t, 1e-12)) - input), 0.0)
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        t = jnp.asarray(target, input.dtype)
+        loss = jnp.where(t == 1, input, jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Pairwise L1-distance hinge; input is a table (x1, x2)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def update_output(self, input, target):
+        x1, x2 = input
+        d = jnp.sum(jnp.abs(x1 - x2))
+        t = jnp.reshape(jnp.asarray(target), ())
+        return jnp.where(t == 1, d, jnp.maximum(0.0, self.margin - d))
+
+
+class MarginCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True, squared: bool = False):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def update_output(self, input, target):
+        t = jnp.asarray(target, input.dtype)
+        h = jnp.maximum(0.0, self.margin - input * t)
+        if self.squared:
+            h = h * h
+        return _reduce(h, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """input = (x1, x2); loss = max(0, -y*(x1-x2) + margin)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        x1, x2 = input
+        y = jnp.asarray(target, x1.dtype)
+        loss = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions over the SAME (input, target)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions: list[Criterion] = []
+        self.weights: list[float] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def update_output(self, input, target):
+        return sum(w * c.update_output(input, target)
+                   for c, w in zip(self.criterions, self.weights))
+
+
+class ParallelCriterion(Criterion):
+    """Each criterion applied to its own (input[i], target[i]) pair."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.repeat_target = repeat_target
+        self.criterions: list[Criterion] = []
+        self.weights: list[float] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def update_output(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.weights)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.update_output(input[i], t)
+        return total
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-class multi-label hinge (torch ``MultiLabelMarginCriterion``).
+    Target rows list positive class indices, padded with -1 (0-based) or 0
+    (1-based)."""
+
+    def __init__(self, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.size_average = size_average
+        self.one_based = one_based
+
+    def update_output(self, input, target):
+        x = input if input.ndim == 2 else input[None, :]
+        t = jnp.asarray(target)
+        t = t if t.ndim == 2 else t[None, :]
+        pad = 0 if self.one_based else -1
+        valid = t != pad
+        idx = (t - 1 if self.one_based else t)
+        idx = jnp.where(valid, idx, 0).astype(jnp.int32)
+        n, c = x.shape
+
+        def per_sample(xi, idxi, validi):
+            pos = xi[idxi]  # (K,)
+            # padding entries scatter to index c (out of bounds → dropped)
+            is_target = jnp.zeros((c,), bool).at[jnp.where(validi, idxi, c)].set(
+                True, mode="drop")
+            # hinge between every valid positive and every non-target class
+            margins = jnp.maximum(0.0, 1.0 - (pos[:, None] - xi[None, :]))
+            margins = margins * validi[:, None] * (~is_target)[None, :]
+            return jnp.sum(margins) / c
+
+        losses = jax.vmap(per_sample)(x, idx, valid)
+        return _reduce(losses, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        t = jnp.asarray(target, input.dtype)
+        # numerically stable log-sigmoid formulation
+        loss = jnp.maximum(input, 0) - input * t + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        if self.weights is not None:
+            loss = loss * self.weights
+        n_class = input.shape[-1]
+        if self.size_average:
+            return jnp.mean(jnp.sum(loss, axis=-1) / n_class)
+        return jnp.sum(loss) / n_class
+
+
+class MultiMarginCriterion(Criterion):
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.p = p
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.margin = margin
+        self.size_average = size_average
+        self.one_based = one_based
+
+    def update_output(self, input, target):
+        x = input if input.ndim == 2 else input[None, :]
+        t = _to_index(target, self.one_based).reshape((x.shape[0],))
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, t[:, None], axis=1)
+        m = jnp.maximum(0.0, self.margin - correct + x)
+        if self.p == 2:
+            m = m * m
+        if self.weights is not None:
+            m = m * self.weights[t][:, None]
+        mask = jax.nn.one_hot(t, c, dtype=x.dtype)
+        loss = jnp.sum(m * (1.0 - mask), axis=1) / c
+        return _reduce(loss, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        t = jnp.asarray(target, input.dtype)
+        return _reduce(jax.nn.softplus(-input * t), self.size_average)
+
+
+class L1Cost(Criterion):
+    def update_output(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """input = (x1, x2), target ±1 (``nn/CosineEmbeddingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        x1, x2 = input
+        if x1.ndim == 1:
+            x1, x2 = x1[None, :], x2[None, :]
+        y = jnp.reshape(jnp.asarray(target, x1.dtype), (-1,))
+        cos = jnp.sum(x1 * x2, axis=1) / jnp.clip(
+            jnp.linalg.norm(x1, axis=1) * jnp.linalg.norm(x2, axis=1), 1e-12)
+        loss = jnp.where(y > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class CosineDistanceCriterion(Criterion):
+    """loss = 1 - cos(input, target) (``nn/CosineDistanceCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        x, t = input, jnp.asarray(target, input.dtype)
+        if x.ndim == 1:
+            x, t = x[None, :], t[None, :]
+        cos = jnp.sum(x * t, axis=1) / jnp.clip(
+            jnp.linalg.norm(x, axis=1) * jnp.linalg.norm(t, axis=1), 1e-12)
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against a regular-simplex embedding of the class label
+    (``nn/ClassSimplexCriterion.scala``)."""
+
+    def __init__(self, n_classes: int, size_average: bool = True, one_based: bool = False):
+        super().__init__()
+        self.n_classes = n_classes
+        self.size_average = size_average
+        self.one_based = one_based
+        self.simplex = jnp.asarray(self._build_simplex(n_classes))
+
+    @staticmethod
+    def _build_simplex(n):
+        import numpy as np
+
+        a = np.zeros((n, n), dtype=np.float32)
+        a[0, 0] = 1.0
+        for k in range(1, n):
+            for c in range(k):
+                a[k, c] = (-1.0 / n - np.dot(a[k, :c], a[c, :c])) / a[c, c]
+            a[k, k] = np.sqrt(max(0.0, 1.0 - np.sum(a[k, :k] ** 2)))
+        return a
+
+    def update_output(self, input, target):
+        t = _to_index(target, self.one_based).reshape((-1,))
+        goal = self.simplex[t]
+        return _reduce((input - goal) ** 2, self.size_average)
+
+
+class DiceCoefficientCriterion(Criterion):
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def update_output(self, input, target):
+        t = jnp.asarray(target, input.dtype)
+        x = input.reshape((input.shape[0], -1)) if input.ndim > 1 else input[None, :]
+        t = t.reshape((x.shape[0], -1))
+        inter = jnp.sum(x * t, axis=1)
+        union = jnp.sum(x, axis=1) + jnp.sum(t, axis=1)
+        dice = 1.0 - 2.0 * inter / (union + self.epsilon)
+        return _reduce(dice, self.size_average)
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply an inner criterion at every timestep of [batch, time, ...]
+    (``nn/TimeDistributedCriterion.scala``)."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = False):
+        super().__init__()
+        self.criterion = criterion
+        self.size_average = size_average
+
+    def update_output(self, input, target):
+        b, t = input.shape[0], input.shape[1]
+        x = input.reshape((b * t,) + input.shape[2:])
+        tt = jnp.asarray(target).reshape((b * t,) + jnp.asarray(target).shape[2:])
+        loss = self.criterion.update_output(x, tt)
+        return loss / t if self.size_average else loss
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe-style SoftmaxWithLoss over spatial maps [N,C,H,W]
+    (``nn/SoftmaxWithCriterion.scala``)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID", one_based: bool = False):
+        super().__init__()
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+        self.one_based = one_based
+
+    def update_output(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=1)
+        t_raw = jnp.asarray(target)
+        if t_raw.dtype in (jnp.float32, jnp.float64, jnp.bfloat16):
+            t_raw = t_raw.astype(jnp.int32)
+        t_raw = t_raw.reshape((input.shape[0],) + input.shape[2:])
+        t = t_raw - 1 if self.one_based else t_raw
+        if self.ignore_label is not None:
+            # ignore_label is in the user's raw convention; clamp ignored
+            # pixels to a valid row before the gather
+            mask = (t_raw != self.ignore_label)
+            t = jnp.where(mask, t, 0)
+        t = jnp.clip(t, 0, input.shape[1] - 1)
+        picked = jnp.take_along_axis(logp, t[:, None, ...], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            picked = picked * mask
+            valid = jnp.sum(mask)
+        else:
+            valid = picked.size
+        total = -jnp.sum(picked)
+        if self.normalize_mode == "VALID":
+            return total / jnp.maximum(valid, 1)
+        if self.normalize_mode == "FULL":
+            return total / picked.size
+        if self.normalize_mode == "BATCH_SIZE":
+            return total / input.shape[0]
+        return total

@@ -1,0 +1,118 @@
+"""Initialization methods.
+
+Parity with the reference's ``InitializationMethod`` family
+(``nn/InitializationMethod.scala``: RandomUniform, RandomNormal, Xavier,
+BilinearFiller, Zeros, Ones, ConstInitMethod, MsraFiller) — host-side eager
+numpy draws through the global Torch-style ``RNG`` so construction is
+deterministic under ``RNG.set_seed``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.utils.rng import RNG
+
+__all__ = [
+    "InitializationMethod",
+    "Zeros",
+    "Ones",
+    "ConstInitMethod",
+    "RandomUniform",
+    "RandomNormal",
+    "Xavier",
+    "MsraFiller",
+    "BilinearFiller",
+]
+
+
+class InitializationMethod:
+    def init(self, shape, fan_in: int | None = None, fan_out: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+
+class _Zeros(InitializationMethod):
+    def init(self, shape, fan_in=None, fan_out=None):
+        return np.zeros(shape, dtype=np.float32)
+
+
+class _Ones(InitializationMethod):
+    def init(self, shape, fan_in=None, fan_out=None):
+        return np.ones(shape, dtype=np.float32)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def init(self, shape, fan_in=None, fan_out=None):
+        return np.full(shape, self.value, dtype=np.float32)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); with no bounds, the Torch default U(-1/sqrt(fan_in), +)."""
+
+    def __init__(self, lower: float | None = None, upper: float | None = None):
+        self.lower, self.upper = lower, upper
+
+    def init(self, shape, fan_in=None, fan_out=None):
+        if self.lower is None:
+            fi = fan_in if fan_in else int(np.prod(shape[1:]) or 1)
+            bound = 1.0 / np.sqrt(fi)
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return RNG.uniform(lo, hi, size=shape).astype(np.float32)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def init(self, shape, fan_in=None, fan_out=None):
+        return RNG.normal(self.mean, self.stdv, size=shape).astype(np.float32)
+
+
+class _Xavier(InitializationMethod):
+    """Glorot uniform: U(-sqrt(6/(fan_in+fan_out)), +)."""
+
+    def init(self, shape, fan_in=None, fan_out=None):
+        fi = fan_in if fan_in else int(np.prod(shape[1:]) or 1)
+        fo = fan_out if fan_out else int(shape[0])
+        bound = np.sqrt(6.0 / (fi + fo))
+        return RNG.uniform(-bound, bound, size=shape).astype(np.float32)
+
+
+class MsraFiller(InitializationMethod):
+    """He/MSRA normal init: N(0, sqrt(2/fan))."""
+
+    def __init__(self, variance_norm_average: bool = False):
+        self.variance_norm_average = variance_norm_average
+
+    def init(self, shape, fan_in=None, fan_out=None):
+        fi = fan_in if fan_in else int(np.prod(shape[1:]) or 1)
+        fo = fan_out if fan_out else int(shape[0])
+        n = (fi + fo) / 2.0 if self.variance_norm_average else fi
+        std = np.sqrt(2.0 / n)
+        return RNG.normal(0.0, std, size=shape).astype(np.float32)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel init for full (transposed) convolutions."""
+
+    def init(self, shape, fan_in=None, fan_out=None):
+        # shape (..., kH, kW)
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = np.ceil(kh / 2.0), np.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        y = np.arange(kh).reshape(-1, 1)
+        x = np.arange(kw).reshape(1, -1)
+        kernel = (1 - np.abs(y / f_h - c_h)) * (1 - np.abs(x / f_w - c_w))
+        out = np.zeros(shape, dtype=np.float32)
+        out[...] = kernel
+        return out
+
+
+Zeros = _Zeros()
+Ones = _Ones()
+Xavier = _Xavier()

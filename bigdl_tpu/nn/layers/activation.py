@@ -1,0 +1,259 @@
+"""Activation layers (SURVEY §2.5 "Activations" — one class per reference
+file under ``nn/``: ReLU, ReLU6, PReLU, RReLU, LeakyReLU, ELU, Tanh,
+TanhShrink, Sigmoid, LogSigmoid, SoftMax, SoftMin, LogSoftMax, SoftPlus,
+SoftShrink, HardShrink, HardTanh, Clamp, Threshold, Power, Square, Sqrt,
+Log, Exp, Abs, GradientReversal).
+
+All are stateless elementwise maps — XLA fuses them into adjacent matmuls,
+so no hand kernels are needed (the reference's MKL VML dispatch in
+``tensor/DenseTensorMath.scala:313-401`` is subsumed by the compiler).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.init import Zeros, ConstInitMethod
+from bigdl_tpu.nn.module import Module, Parameter
+from bigdl_tpu.utils.rng import next_rng_id, require_rng
+
+__all__ = [
+    "ReLU", "ReLU6", "PReLU", "RReLU", "LeakyReLU", "ELU", "Tanh",
+    "TanhShrink", "Sigmoid", "LogSigmoid", "SoftMax", "SoftMin",
+    "LogSoftMax", "SoftPlus", "SoftShrink", "HardShrink", "HardTanh",
+    "Clamp", "Threshold", "Power", "Square", "Sqrt", "Log", "Exp", "Abs",
+    "GradientReversal",
+]
+
+
+class ReLU(Module):
+    def __init__(self, ip: bool = False):
+        super().__init__()
+
+    def update_output(self, input):
+        return jax.nn.relu(input)
+
+
+class ReLU6(Module):
+    def update_output(self, input):
+        return jnp.clip(input, 0.0, 6.0)
+
+
+class PReLU(Module):
+    """Learnable leaky slope; n_output_plane=0 shares one slope
+    (``nn/PReLU.scala``)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        n = max(1, n_output_plane)
+        self.weight = Parameter(jnp.full((n,), 0.25, jnp.float32))
+
+    def reset(self):
+        n = max(1, self.n_output_plane)
+        self.weight = jnp.full((n,), 0.25, jnp.float32)
+
+    def update_output(self, input):
+        w = self.weight
+        if self.n_output_plane > 0:
+            # channel axis is 1 for batched NCHW-style input, 0 otherwise
+            shape = [1] * input.ndim
+            ch_axis = 1 if input.ndim > 1 else 0
+            shape[ch_axis] = self.n_output_plane
+            w = w.reshape(shape)
+        return jnp.where(input > 0, input, w * input)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training, the mean
+    slope in eval (``nn/RReLU.scala``)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3, ip: bool = False):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+        self._rng_id = next_rng_id()
+
+    def update_output(self, input):
+        if self.training:
+            key = require_rng(self._rng_id)
+            a = jax.random.uniform(key, jnp.shape(input), input.dtype, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, a * input)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negval: float = 0.01, ip: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def update_output(self, input):
+        return jnp.where(input >= 0, input, self.negval * input)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def update_output(self, input):
+        return jnp.where(input > 0, input, self.alpha * jnp.expm1(input))
+
+
+class Tanh(Module):
+    def update_output(self, input):
+        return jnp.tanh(input)
+
+
+class TanhShrink(Module):
+    def update_output(self, input):
+        return input - jnp.tanh(input)
+
+
+class Sigmoid(Module):
+    def update_output(self, input):
+        return jax.nn.sigmoid(input)
+
+
+class LogSigmoid(Module):
+    def update_output(self, input):
+        return jax.nn.log_sigmoid(input)
+
+
+class SoftMax(Module):
+    """Softmax over the feature axis (``nn/SoftMax.scala``: dim 1 of
+    [batch, n] or the only dim of [n])."""
+
+    def update_output(self, input):
+        axis = 1 if input.ndim >= 2 else 0
+        return jax.nn.softmax(input, axis=axis)
+
+
+class SoftMin(Module):
+    def update_output(self, input):
+        axis = 1 if input.ndim >= 2 else 0
+        return jax.nn.softmax(-input, axis=axis)
+
+
+class LogSoftMax(Module):
+    """(``nn/LogSoftMax.scala:21`` — MKL-accelerated there; XLA-fused here)."""
+
+    def update_output(self, input):
+        axis = 1 if input.ndim >= 2 else 0
+        return jax.nn.log_softmax(input, axis=axis)
+
+
+class SoftPlus(Module):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def update_output(self, input):
+        return jax.nn.softplus(self.beta * input) / self.beta
+
+
+class SoftShrink(Module):
+    def __init__(self, lam: float = 0.5):
+        super().__init__()
+        self.lam = lam
+
+    def update_output(self, input):
+        return jnp.where(input > self.lam, input - self.lam,
+                         jnp.where(input < -self.lam, input + self.lam, 0.0))
+
+
+class HardShrink(Module):
+    def __init__(self, lam: float = 0.5):
+        super().__init__()
+        self.lam = lam
+
+    def update_output(self, input):
+        return jnp.where(jnp.abs(input) > self.lam, input, 0.0)
+
+
+class HardTanh(Module):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def update_output(self, input):
+        return jnp.clip(input, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__(min_value, max_value)
+
+
+class Threshold(Module):
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th, self.v = th, v
+
+    def update_output(self, input):
+        return jnp.where(input > self.th, input, self.v)
+
+
+class Power(Module):
+    """(shift + scale * x) ** power (``nn/Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def update_output(self, input):
+        return jnp.power(self.shift + self.scale * input, self.power)
+
+
+class Square(Module):
+    def update_output(self, input):
+        return input * input
+
+
+class Sqrt(Module):
+    def update_output(self, input):
+        return jnp.sqrt(input)
+
+
+class Log(Module):
+    def update_output(self, input):
+        return jnp.log(input)
+
+
+class Exp(Module):
+    def update_output(self, input):
+        return jnp.exp(input)
+
+
+class Abs(Module):
+    def update_output(self, input):
+        return jnp.abs(input)
+
+
+class GradientReversal(Module):
+    """Identity forward, negated+scaled gradient (``nn/GradientReversal.scala``)."""
+
+    def __init__(self, lam: float = 1.0):
+        super().__init__()
+        self.lam = lam
+
+    def update_output(self, input):
+        lam = self.lam
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (-lam * g,)
+
+        rev.defvjp(fwd, bwd)
+        return rev(input)
+
+    def set_lambda(self, lam: float):
+        self.lam = lam
+        return self

@@ -1,0 +1,351 @@
+"""Linear-algebra layers (SURVEY §2.5: Linear, Bilinear, CMul, CAdd, Mul,
+Add, MulConstant, AddConstant, MM, MV, DotProduct, Cosine, CosineDistance,
+Euclidean, PairwiseDistance, LookupTable, MixtureTable).
+
+Matmuls map straight onto the TPU MXU via ``jnp.dot``/``einsum``; the
+reference's MKL gemm dispatch (``tensor/DenseTensorBLAS.scala``) has no
+analogue here — XLA owns the tiling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.init import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = [
+    "Linear", "Bilinear", "CMul", "CAdd", "Mul", "Add", "MulConstant",
+    "AddConstant", "MM", "MV", "DotProduct", "Cosine", "CosineDistance",
+    "Euclidean", "PairwiseDistance", "LookupTable", "MixtureTable",
+]
+
+
+class Linear(Module):
+    """y = x W^T + b (``nn/Linear.scala``).  Weight layout (out, in) as in
+    the reference; regularizers applied by the training step."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = with_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        self.weight_init: InitializationMethod = RandomUniform()
+        self.bias_init: InitializationMethod = RandomUniform()
+        if init_weight is not None:
+            self.weight = Parameter(init_weight)
+        else:
+            self.weight = Parameter(self.weight_init.init(
+                (output_size, input_size), fan_in=input_size, fan_out=output_size))
+        if with_bias:
+            if init_bias is not None:
+                self.bias = Parameter(init_bias)
+            else:
+                self.bias = Parameter(self.bias_init.init(
+                    (output_size,), fan_in=input_size, fan_out=output_size))
+
+    def reset(self):
+        self.weight = self.weight_init.init(
+            (self.output_size, self.input_size),
+            fan_in=self.input_size, fan_out=self.output_size)
+        if self.with_bias:
+            self.bias = self.bias_init.init(
+                (self.output_size,), fan_in=self.input_size, fan_out=self.output_size)
+
+    def update_output(self, input):
+        squeeze = input.ndim == 1
+        x = input[None, :] if squeeze else input
+        y = jnp.dot(x, self.weight.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.with_bias:
+            y = y + self.bias
+        return y[0] if squeeze else y
+
+
+class Bilinear(Module):
+    """y_k = x1^T W_k x2 + b_k over a table input (x1, x2)
+    (``nn/Bilinear.scala``)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True, w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size1, self.input_size2 = input_size1, input_size2
+        self.output_size, self.bias_res = output_size, bias_res
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        self.weight_init = RandomUniform()
+        self.bias_init = RandomUniform()
+        self.reset()
+
+    def reset(self):
+        fan = self.input_size1 * self.input_size2
+        self.weight = Parameter(self.weight_init.init(
+            (self.output_size, self.input_size1, self.input_size2), fan_in=fan))
+        if self.bias_res:
+            self.bias = Parameter(self.bias_init.init((self.output_size,), fan_in=fan))
+
+    def update_output(self, input):
+        x1, x2 = input
+        y = jnp.einsum("bi,oij,bj->bo", x1, self.weight, x2)
+        if self.bias_res:
+            y = y + self.bias
+        return y
+
+
+class CMul(Module):
+    """Learnable per-element scale, broadcast over the batch
+    (``nn/CMul.scala``)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.weight = Parameter(jnp.ones(self.size, jnp.float32))
+
+    def reset(self):
+        import numpy as np
+
+        std = 1.0 / np.sqrt(np.prod(self.size))
+        self.weight = RandomUniform(-std, std).init(self.size)
+
+    def update_output(self, input):
+        return input * self.weight
+
+
+class CAdd(Module):
+    """Learnable per-element bias (``nn/CAdd.scala``)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.bias = Parameter(jnp.zeros(self.size, jnp.float32))
+
+    def reset(self):
+        import numpy as np
+
+        std = 1.0 / np.sqrt(np.prod(self.size))
+        self.bias = RandomUniform(-std, std).init(self.size)
+
+    def update_output(self, input):
+        return input + self.bias
+
+
+class Mul(Module):
+    """Single learnable scalar multiplier (``nn/Mul.scala``)."""
+
+    def __init__(self):
+        super().__init__()
+        self.weight = Parameter(jnp.ones((1,), jnp.float32))
+
+    def reset(self):
+        self.weight = RandomUniform(-1.0, 1.0).init((1,))
+
+    def update_output(self, input):
+        return input * self.weight[0]
+
+
+class Add(Module):
+    """Learnable bias vector over the feature dim (``nn/Add.scala``)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.bias = Parameter(jnp.zeros((input_size,), jnp.float32))
+
+    def reset(self):
+        import numpy as np
+
+        std = 1.0 / np.sqrt(self.input_size)
+        self.bias = RandomUniform(-std, std).init((self.input_size,))
+
+    def update_output(self, input):
+        return input + self.bias
+
+
+class MulConstant(Module):
+    def __init__(self, scalar: float, ip: bool = False):
+        super().__init__()
+        self.scalar = scalar
+
+    def update_output(self, input):
+        return input * self.scalar
+
+
+class AddConstant(Module):
+    def __init__(self, constant: float, ip: bool = False):
+        super().__init__()
+        self.constant = constant
+
+    def update_output(self, input):
+        return input + self.constant
+
+
+class MM(Module):
+    """Batch/plain matmul over a table (a, b) with optional transposes
+    (``nn/MM.scala``)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def update_output(self, input):
+        a, b = input
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(Module):
+    """Matrix-vector product over a table (mat, vec) (``nn/MV.scala``)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def update_output(self, input):
+        m, v = input
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class DotProduct(Module):
+    def update_output(self, input):
+        a, b = input
+        if a.ndim == 1:
+            return jnp.sum(a * b)[None]
+        return jnp.sum(a * b, axis=-1)
+
+
+class Cosine(Module):
+    """Cosine similarity of the input against each row of a learnable weight
+    (``nn/Cosine.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.weight_init = RandomUniform()
+        self.weight = Parameter(self.weight_init.init(
+            (output_size, input_size), fan_in=input_size))
+
+    def reset(self):
+        self.weight = self.weight_init.init(
+            (self.output_size, self.input_size), fan_in=self.input_size)
+
+    def update_output(self, input):
+        squeeze = input.ndim == 1
+        x = input[None, :] if squeeze else input
+        xn = x / jnp.clip(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        wn = self.weight / jnp.clip(jnp.linalg.norm(self.weight, axis=1, keepdims=True), 1e-12)
+        y = xn @ wn.T
+        return y[0] if squeeze else y
+
+
+class CosineDistance(Module):
+    """Cosine similarity over a table (a, b) (``nn/CosineDistance.scala``)."""
+
+    def update_output(self, input):
+        a, b = input
+        squeeze = a.ndim == 1
+        if squeeze:
+            a, b = a[None, :], b[None, :]
+        cos = jnp.sum(a * b, axis=1) / jnp.clip(
+            jnp.linalg.norm(a, axis=1) * jnp.linalg.norm(b, axis=1), 1e-12)
+        return cos[0] if squeeze else cos
+
+
+class Euclidean(Module):
+    """Distance from the input to each learnable center
+    (``nn/Euclidean.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, fast_backward: bool = True):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.weight_init = RandomUniform()
+        self.weight = Parameter(self.weight_init.init(
+            (output_size, input_size), fan_in=input_size))
+
+    def reset(self):
+        self.weight = self.weight_init.init(
+            (self.output_size, self.input_size), fan_in=self.input_size)
+
+    def update_output(self, input):
+        squeeze = input.ndim == 1
+        x = input[None, :] if squeeze else input
+        d = jnp.linalg.norm(x[:, None, :] - self.weight[None, :, :], axis=-1)
+        return d[0] if squeeze else d
+
+
+class PairwiseDistance(Module):
+    """L-p distance over a table (a, b) (``nn/PairwiseDistance.scala``)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def update_output(self, input):
+        a, b = input
+        squeeze = a.ndim == 1
+        if squeeze:
+            a, b = a[None, :], b[None, :]
+        d = jnp.sum(jnp.abs(a - b) ** self.norm, axis=1) ** (1.0 / self.norm)
+        return d[0] if squeeze else d
+
+
+class LookupTable(Module):
+    """Embedding lookup with optional max-norm renorm and padding row
+    (``nn/LookupTable.scala``). Index gather is TPU-friendly (no scatter in
+    forward; the backward scatter-add is XLA's problem)."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0.0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, w_regularizer=None,
+                 one_based: bool = False):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm, self.norm_type = max_norm, norm_type
+        self.w_regularizer = w_regularizer
+        self.one_based = one_based
+        from bigdl_tpu.nn.init import RandomNormal
+
+        self.weight_init = RandomNormal(0.0, 1.0)
+        self.weight = Parameter(self.weight_init.init((n_index, n_output)))
+
+    def reset(self):
+        self.weight = self.weight_init.init((self.n_index, self.n_output))
+
+    def update_output(self, input):
+        idx = jnp.asarray(input)
+        if idx.dtype in (jnp.float32, jnp.float64, jnp.bfloat16):
+            idx = idx.astype(jnp.int32)
+        if self.one_based:
+            idx = idx - 1
+        w = self.weight
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.clip(norms, 1e-12))
+        return w[idx]
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts combiner: input = (gates, experts)
+    (``nn/MixtureTable.scala``).  Experts either a stacked tensor
+    [batch, n_experts, ...] or a table of per-expert tensors."""
+
+    def __init__(self, dim: Optional[int] = None):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        gates, experts = input
+        if isinstance(experts, (list, tuple)):
+            experts = jnp.stack(list(experts), axis=1)
+        g = gates
+        while g.ndim < experts.ndim:
+            g = g[..., None]
+        return jnp.sum(g * experts, axis=1)

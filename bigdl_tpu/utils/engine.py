@@ -1,0 +1,121 @@
+"""Engine — the global runtime singleton.
+
+Capability parity with ``utils/Engine.scala``: the reference's
+``Engine.init`` discovers node count and cores per executor from the Spark
+conf, owns the task/model thread pools, and verifies the runtime contract.
+On TPU the executor topology is the **device mesh**: ``Engine.init``
+discovers ``jax.devices()``, builds the default ``jax.sharding.Mesh``, and
+owns host-side worker pools for the input pipeline (the reference's
+``ThreadPool``/``Engine.default`` role — compute parallelism itself lives
+inside XLA, so there is no ``_model`` pool).
+
+Config parity (``Engine.scala:113-154`` system properties): environment
+variables ``BIGDL_*`` replace JVM ``-Dbigdl.*`` properties.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Engine"]
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+class _Engine:
+    def __init__(self):
+        self._initialized = False
+        self._mesh = None
+        self._devices = None
+        self._node_number = 1
+        self._core_number = 1
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self.local_mode = os.environ.get("BIGDL_LOCAL_MODE", "").lower() in ("1", "true")
+
+    # -- init ---------------------------------------------------------------
+    def init(self, devices=None, mesh_shape: Optional[Sequence[int]] = None,
+             axis_names: Sequence[str] = ("data",)) -> "_Engine":
+        """Discover devices and build the default mesh.
+
+        ``mesh_shape=None`` puts every addressable device on the leading
+        axis (pure data parallelism, the reference's only mode); richer
+        layouts (data × model × sequence) are first-class via
+        ``bigdl_tpu.parallel.mesh``.
+        """
+        import jax
+
+        self._devices = list(devices) if devices is not None else jax.devices()
+        n = len(self._devices)
+        if mesh_shape is None:
+            mesh_shape = (n,)
+            axis_names = tuple(axis_names[:1])
+        arr = np.array(self._devices).reshape(tuple(mesh_shape))
+        from jax.sharding import Mesh
+
+        self._mesh = Mesh(arr, tuple(axis_names))
+        self._node_number = _env_int("BIGDL_NODE_NUMBER", n)
+        self._core_number = _env_int("BIGDL_CORE_NUMBER", os.cpu_count() or 1)
+        pool_size = _env_int("BIGDL_DEFAULT_POOL_SIZE", max(4, self._core_number))
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self._pool = ThreadPoolExecutor(max_workers=pool_size, thread_name_prefix="bigdl")
+        self._initialized = True
+        return self
+
+    def _require_init(self):
+        if not self._initialized:
+            self.init()
+
+    # -- accessors (Engine.coreNumber/nodeNumber/default parity) ------------
+    @property
+    def mesh(self):
+        self._require_init()
+        return self._mesh
+
+    @property
+    def devices(self):
+        self._require_init()
+        return self._devices
+
+    def node_number(self) -> int:
+        self._require_init()
+        return self._node_number
+
+    def core_number(self) -> int:
+        self._require_init()
+        return self._core_number
+
+    def device_count(self) -> int:
+        self._require_init()
+        return len(self._devices)
+
+    @property
+    def default(self) -> ThreadPoolExecutor:
+        """Host-side worker pool (data loading / IO), the analogue of
+        ``Engine.default`` (``Engine.scala:241-246``)."""
+        self._require_init()
+        return self._pool
+
+    def invoke_and_wait(self, fns, timeout: Optional[float] = None):
+        """Run thunks on the pool and gather results — ``ThreadPool.
+        invokeAndWait`` (``utils/ThreadPool.scala:92-104``)."""
+        self._require_init()
+        futures = [self._pool.submit(f) for f in fns]
+        return [f.result(timeout=timeout) for f in futures]
+
+    def reset(self):
+        self._initialized = False
+        self._mesh = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+
+Engine = _Engine()

@@ -1,0 +1,128 @@
+"""Random number generation for bigdl_tpu.
+
+Capability parity with the reference's Torch-compatible RNG singleton
+(``utils/RandomGenerator.scala:56``: Mersenne-Twister state, thread-local
+``RNG``, uniform/normal/bernoulli draws used by layer initialisation) —
+re-designed for JAX:
+
+- **Init-time randomness** (weight initialisation) is host-side and eager,
+  driven by a numpy ``Generator`` (MT19937, like the reference) held in the
+  global ``RNG`` object.  ``RNG.set_seed`` makes model construction
+  deterministic, mirroring ``RandomGenerator.RNG.setSeed``.
+
+- **Trace-time randomness** (dropout, RReLU noise, random ops) cannot use an
+  impure host RNG under ``jit``: it flows through an explicit
+  ``jax.random.key`` threaded by the training step and exposed to modules via
+  a dynamic *RNG context*.  Each stochastic module folds its unique static id
+  into the context key (``jax.random.fold_in``), so a single key per step
+  deterministically derives independent streams for every layer — the JAX
+  analogue of the reference's per-thread RNG clones.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+__all__ = ["RandomGenerator", "RNG", "rng_context", "require_rng", "next_rng_id"]
+
+
+class RandomGenerator:
+    """Host-side eager RNG used for parameter initialisation.
+
+    Mirrors the call surface of the reference's ``RandomGenerator``
+    (uniform/normal/bernoulli + seed control) on top of numpy MT19937.
+    """
+
+    def __init__(self, seed: int | None = None):
+        self._seed = seed if seed is not None else 0
+        self._gen = np.random.Generator(np.random.MT19937(self._seed))
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        self._seed = int(seed)
+        self._gen = np.random.Generator(np.random.MT19937(self._seed))
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def uniform(self, a: float = 0.0, b: float = 1.0, size=None) -> np.ndarray:
+        return self._gen.uniform(a, b, size=size)
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0, size=None) -> np.ndarray:
+        return self._gen.normal(mean, stdv, size=size)
+
+    def bernoulli(self, p: float, size=None) -> np.ndarray:
+        return (self._gen.uniform(0.0, 1.0, size=size) < p).astype(np.float32)
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self._gen.permutation(n)
+
+    def randint(self, low: int, high: int, size=None) -> np.ndarray:
+        return self._gen.integers(low, high, size=size)
+
+
+#: Global init-time RNG (thread-local in the reference; a process-global here —
+#: model construction is host-side and single-threaded in practice).
+RNG = RandomGenerator(seed=0)
+
+
+# --------------------------------------------------------------------------
+# Trace-time RNG context
+# --------------------------------------------------------------------------
+
+_rng_id_lock = threading.Lock()
+_rng_id_counter = [0]
+
+
+def next_rng_id() -> int:
+    """Allocate a unique static id for a stochastic module instance."""
+    with _rng_id_lock:
+        _rng_id_counter[0] += 1
+        return _rng_id_counter[0]
+
+
+class _RngContext(threading.local):
+    def __init__(self):
+        self.key = None
+
+
+_ctx = _RngContext()
+
+
+@contextlib.contextmanager
+def rng_context(key):
+    """Install a (possibly traced) ``jax.random`` key for the dynamic extent
+    of a forward pass.  The training step does::
+
+        with rng_context(step_key):
+            out = model.forward(x)
+    """
+    prev = _ctx.key
+    _ctx.key = key
+    try:
+        yield
+    finally:
+        _ctx.key = prev
+
+
+def current_rng_key():
+    return _ctx.key
+
+
+def require_rng(module_id: int, salt: int = 0):
+    """Derive this module's key from the active context.
+
+    Falls back to a fresh host-seeded key outside any context (eager use),
+    so `model.forward(x)` works interactively without ceremony.
+    """
+    key = _ctx.key
+    if key is None:
+        key = jax.random.key(int(RNG.randint(0, 2**31 - 1)))
+    key = jax.random.fold_in(key, module_id)
+    if salt:
+        key = jax.random.fold_in(key, salt)
+    return key

@@ -1,0 +1,30 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding paths are exercised without TPU hardware (the analogue
+of the reference's `local[N]` + Engine-override distributed tests,
+``optim/DistriOptimizerSpec.scala:40-41``)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon TPU plugin registers itself from sitecustomize and overrides the
+# platform selection; force the virtual 8-device CPU backend for tests.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(42)
+    yield

@@ -1,0 +1,156 @@
+"""Core module-system tests: registration, forward/backward-vs-autodiff,
+functional_call purity, flattened parameters, freeze/scale semantics.
+Oracle: torch (CPU) where a reference formula exists."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import functional_call, state_dict, load_state_dict
+
+
+def test_linear_forward_matches_torch():
+    layer = nn.Linear(5, 3)
+    tl = torch.nn.Linear(5, 3)
+    with torch.no_grad():
+        tl.weight.copy_(torch.tensor(np.asarray(layer.weight)))
+        tl.bias.copy_(torch.tensor(np.asarray(layer.bias)))
+    x = np.random.randn(4, 5).astype(np.float32)
+    out = layer.forward(jnp.asarray(x))
+    ref = tl(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_backward_matches_torch_grads():
+    layer = nn.Linear(5, 3)
+    tl = torch.nn.Linear(5, 3)
+    with torch.no_grad():
+        tl.weight.copy_(torch.tensor(np.asarray(layer.weight)))
+        tl.bias.copy_(torch.tensor(np.asarray(layer.bias)))
+    x = np.random.randn(4, 5).astype(np.float32)
+    g = np.random.randn(4, 3).astype(np.float32)
+
+    layer.zero_grad_parameters()
+    layer.forward(jnp.asarray(x))
+    grad_in = layer.backward(jnp.asarray(x), jnp.asarray(g))
+
+    tx = torch.tensor(x, requires_grad=True)
+    tl(tx).backward(torch.tensor(g))
+    np.testing.assert_allclose(np.asarray(grad_in), tx.grad.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(layer._grads["weight"]), tl.weight.grad.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(layer._grads["bias"]), tl.bias.grad.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_sequential_chain_and_naming():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = jnp.ones((3, 4))
+    out = model.forward(x)
+    assert out.shape == (3, 2)
+    params = dict(model.named_parameters())
+    assert set(params) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+
+
+def test_functional_call_is_pure():
+    model = nn.Sequential(nn.Linear(4, 4), nn.Tanh())
+    x = jnp.ones((2, 4))
+    eager = model.forward(x)
+    params = state_dict(model)
+    before = {k: np.asarray(v) for k, v in params.items()}
+
+    @jax.jit
+    def f(p, x):
+        out, new_p = functional_call(model, p, x)
+        return out
+
+    out = f(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(eager), rtol=1e-6)
+    after = state_dict(model)
+    for k in before:
+        np.testing.assert_array_equal(before[k], np.asarray(after[k]))
+        assert isinstance(after[k], jax.Array) and not isinstance(
+            after[k], jax.core.Tracer)
+
+
+def test_functional_call_grad():
+    model = nn.Linear(3, 1, with_bias=False)
+    x = jnp.ones((2, 3))
+
+    def loss(p):
+        out, _ = functional_call(model, p, x)
+        return jnp.sum(out)
+
+    g = jax.grad(loss)(state_dict(model))
+    np.testing.assert_allclose(np.asarray(g["weight"]), np.full((1, 3), 2.0), rtol=1e-6)
+
+
+def test_get_parameters_flat_roundtrip():
+    model = nn.Sequential(nn.Linear(4, 3), nn.Linear(3, 2))
+    flat, _ = model.get_parameters()
+    assert flat.shape == (4 * 3 + 3 + 3 * 2 + 2,)
+    model.set_flat_parameters(flat * 2.0)
+    flat2, _ = model.get_parameters()
+    np.testing.assert_allclose(np.asarray(flat2), np.asarray(flat) * 2.0, rtol=1e-6)
+
+
+def test_freeze_blocks_grad_accumulation():
+    model = nn.Sequential(nn.Linear(3, 3), nn.Linear(3, 2))
+    model.get(0).freeze()
+    x = jnp.ones((2, 3))
+    model.zero_grad_parameters()
+    model.forward(x)
+    model.backward(x, jnp.ones((2, 2)))
+    assert "weight" not in model.get(0)._grads
+    assert "weight" in model.get(1)._grads
+
+
+def test_scale_w_applied_to_grads():
+    layer = nn.Linear(3, 2).set_scale_w(0.5)
+    x = jnp.ones((2, 3))
+    layer.zero_grad_parameters()
+    layer.forward(x)
+    layer.backward(x, jnp.ones((2, 2)))
+    base = nn.Linear(3, 2)
+    load_state_dict(base, state_dict(layer))
+    base.zero_grad_parameters()
+    base.forward(x)
+    base.backward(x, jnp.ones((2, 2)))
+    np.testing.assert_allclose(
+        np.asarray(layer._grads["weight"]),
+        0.5 * np.asarray(base._grads["weight"]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(layer._grads["bias"]), np.asarray(base._grads["bias"]), rtol=1e-6)
+
+
+def test_train_eval_modes_and_clone():
+    model = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+    model.evaluate()
+    assert not model.is_training()
+    clone = model.clone_module()
+    clone.train()
+    assert clone.is_training() and not model.is_training()
+    x = jnp.ones((1, 2))
+    np.testing.assert_allclose(
+        np.asarray(model.forward(x)), np.asarray(clone.forward(x)), rtol=1e-6)
+
+
+def test_update_parameters_sgd_step():
+    layer = nn.Linear(2, 2, with_bias=False)
+    w0 = np.asarray(layer.weight)
+    x = jnp.ones((1, 2))
+    layer.zero_grad_parameters()
+    layer.forward(x)
+    layer.backward(x, jnp.ones((1, 2)))
+    layer.update_parameters(0.1)
+    np.testing.assert_allclose(
+        np.asarray(layer.weight), w0 - 0.1 * np.asarray(layer._grads["weight"]), rtol=1e-6)
+
+
+def test_layer_exception_wraps_path():
+    layer = nn.Linear(3, 2).set_name("clf")
+    with pytest.raises(nn.LayerException, match="clf"):
+        layer.forward(jnp.ones((2, 4)))  # wrong input size
