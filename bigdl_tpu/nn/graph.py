@@ -1,0 +1,158 @@
+"""Functional/DAG model API (``nn/Graph.scala:72``, ``utils/DirectedGraph.scala``).
+
+Users build graphs exactly like the reference's functional API::
+
+    inp = Input()
+    fc1 = Linear(10, 20).inputs(inp)
+    act = ReLU().inputs(fc1)
+    out = Linear(20, 2).inputs(act)
+    model = Graph(inp, out)
+
+Execution is a host-side topological walk during tracing — under ``jit``
+the whole DAG flattens into one XLA computation, so the reference's
+ready-queue ``Scheduler`` (``nn/Scheduler.scala``) is unnecessary for
+acyclic graphs; its control-flow cycles (while-loops) map to
+``jax.lax.while_loop`` via ``bigdl_tpu.ops.control`` instead.
+
+``stop_gradient(names)`` reproduces ``Graph.stopGradient`` with
+``jax.lax.stop_gradient`` on the named nodes' outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Identity, Module
+
+__all__ = ["Node", "Input", "Graph", "node_from_module"]
+
+
+class Node:
+    """DAG node wrapping a module (``utils/DirectedGraph.scala:175``)."""
+
+    _counter = [0]
+
+    def __init__(self, element: Module):
+        self.element = element
+        self.prev: List[Tuple["Node", Optional[int]]] = []  # (node, from_index)
+        self.next: List["Node"] = []
+        Node._counter[0] += 1
+        self.id = Node._counter[0]
+
+    def add_prev(self, node: "Node", from_index: Optional[int] = None):
+        self.prev.append((node, from_index))
+        node.next.append(self)
+
+    # allow chaining: Linear(...)(node) style via module.inputs
+    def __repr__(self):
+        return f"Node({self.element.get_name()})"
+
+
+def node_from_module(module: Module, nodes: Sequence) -> Node:
+    n = Node(module)
+    for item in nodes:
+        if isinstance(item, tuple) and not isinstance(item, Node):
+            src, idx = item
+            n.add_prev(src, idx)
+        else:
+            n.add_prev(item)
+    return n
+
+
+def Input(name: Optional[str] = None) -> Node:
+    """Create an input placeholder node (``nn/Input.scala``)."""
+    m = Identity()
+    if name:
+        m.set_name(name)
+    return Node(m)
+
+
+def _topo_sort(outputs: List[Node]) -> List[Node]:
+    order: List[Node] = []
+    seen: Dict[int, int] = {}  # id -> 0 visiting, 1 done
+
+    def visit(n: Node):
+        state = seen.get(n.id)
+        if state == 1:
+            return
+        if state == 0:
+            raise ValueError("Graph contains a cycle; use ops.control for loops")
+        seen[n.id] = 0
+        for p, _ in n.prev:
+            visit(p)
+        seen[n.id] = 1
+        order.append(n)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+class Graph(Container):
+    """DAG container (``nn/Graph.scala``)."""
+
+    def __init__(self, inputs, outputs, variables=None):
+        super().__init__()
+        self.input_nodes: List[Node] = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        self.output_nodes: List[Node] = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+        self._sorted = _topo_sort(self.output_nodes)
+        input_ids = {n.id for n in self.input_nodes}
+        missing = [n for n in self._sorted if not n.prev and n.id not in input_ids
+                   and not getattr(n.element, "_is_const", False)]
+        for n in missing:
+            if not _is_without_input(n.element):
+                raise ValueError(f"node {n} has no inputs and is not an Input node")
+        self._stop_gradient: set = set()
+        # register the modules so parameters are discoverable; keys must be
+        # unique even when user names collide, or params silently vanish
+        used = set()
+        for i, n in enumerate(self._sorted):
+            if n.id in input_ids:
+                continue
+            key = n.element.__dict__["_name"] or f"node{i}"
+            if key in used:
+                key = f"{key}__{i}"
+            used.add(key)
+            self.__dict__["_modules"][key] = n.element
+
+    def stop_gradient(self, names: Sequence[str]) -> "Graph":
+        """Block gradients flowing through the named nodes
+        (``nn/Graph.scala`` stopGradient)."""
+        self._stop_gradient |= set(names)
+        return self
+
+    def update_output(self, input):
+        values: Dict[int, object] = {}
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"graph expects {len(self.input_nodes)} inputs, got {len(inputs)}")
+        for n, v in zip(self.input_nodes, inputs):
+            values[n.id] = v
+        for n in self._sorted:
+            if n.id in values:
+                continue
+            if not n.prev:
+                node_in = None
+            else:
+                gathered = []
+                for p, idx in n.prev:
+                    v = values[p.id]
+                    if idx is not None:
+                        v = v[idx]
+                    gathered.append(v)
+                node_in = gathered[0] if len(gathered) == 1 else gathered
+            out = n.element.forward(node_in)
+            name = n.element.__dict__["_name"]
+            if name and name in self._stop_gradient:
+                out = jax.tree.map(jax.lax.stop_gradient, out)
+            values[n.id] = out
+        outs = [values[o.id] for o in self.output_nodes]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def _is_without_input(m: Module) -> bool:
+    return getattr(m, "_without_input", False)

@@ -1,0 +1,76 @@
+"""Composite containers beyond Sequential (SURVEY §2.4: Concat,
+ConcatTable, ParallelTable, MapTable, TimeDistributed, and the
+table-routing helpers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Module
+
+__all__ = [
+    "Concat", "ConcatTable", "ParallelTable", "MapTable", "TimeDistributed",
+]
+
+
+class Concat(Container):
+    """Apply every member to the same input, concatenate outputs along
+    ``dim`` (``nn/Concat.scala``; reference dim 1 of [batch, ...] — here an
+    explicit 0-based axis, default 1)."""
+
+    def __init__(self, dim: int = 1):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        return jnp.concatenate([m.forward(input) for m in self.layers], axis=self.dim)
+
+
+class ConcatTable(Container):
+    """Apply every member to the same input, output a table
+    (``nn/ConcatTable.scala``)."""
+
+    def update_output(self, input):
+        return [m.forward(input) for m in self.layers]
+
+
+class ParallelTable(Container):
+    """Member i applied to input[i] (``nn/ParallelTable.scala``)."""
+
+    def update_output(self, input):
+        return [m.forward(x) for m, x in zip(self.layers, input)]
+
+
+class MapTable(Container):
+    """One module applied to every table element (``nn/MapTable.scala``).
+    The reference clones the module per element with shared weights; under
+    the functional core the SAME module instance is simply reused — weight
+    sharing is the default."""
+
+    def __init__(self, module: Optional[Module] = None):
+        super().__init__()
+        if module is not None:
+            self.add(module)
+
+    def update_output(self, input):
+        m = self.layers[0]
+        return [m.forward(x) for x in input]
+
+
+class TimeDistributed(Container):
+    """Apply the inner module to every timestep of [batch, time, ...]
+    (``nn/TimeDistributed.scala``) by folding time into the batch — one big
+    MXU-friendly batched op instead of a per-step loop."""
+
+    def __init__(self, module: Module):
+        super().__init__()
+        self.add(module)
+
+    def update_output(self, input):
+        b, t = input.shape[0], input.shape[1]
+        flat = input.reshape((b * t,) + input.shape[2:])
+        out = self.layers[0].forward(flat)
+        return out.reshape((b, t) + out.shape[1:])

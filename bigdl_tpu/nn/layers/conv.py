@@ -1,0 +1,381 @@
+"""Convolution layers (SURVEY §2.5 "Convolutions": SpatialConvolution,
+SpatialShareConvolution, SpatialFullConvolution, SpatialDilatedConvolution,
+SpatialConvolutionMap, TemporalConvolution, VolumetricConvolution,
+VolumetricFullConvolution).
+
+The reference lowers convs to hand-written im2col + MKL gemm
+(``nn/SpatialConvolution.scala:224+``, ``nn/NNPrimitive.scala:24-592``).
+On TPU that entire machinery is one ``lax.conv_general_dilated`` — XLA
+tiles it onto the MXU directly; im2col would only waste HBM bandwidth.
+
+Conventions kept from the reference: Torch weight layout
+(out, in/group, kH, kW), NCHW or NHWC data formats, ``pad = -1`` meaning
+SAME padding, ``n_group`` for grouped convolution.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.init import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = [
+    "SpatialConvolution", "SpatialShareConvolution", "SpatialFullConvolution",
+    "SpatialDilatedConvolution", "SpatialConvolutionMap",
+    "TemporalConvolution", "VolumetricConvolution", "VolumetricFullConvolution",
+]
+
+
+def _pair_padding(pad: int, k: int, stride: int, size: Optional[int] = None) -> Tuple[int, int]:
+    """Explicit (lo, hi) padding; pad == -1 is SAME (TF convention)."""
+    if pad == -1:
+        if size is None:
+            # SAME with unknown size: symmetric k-based padding (stride-1 exact)
+            total = k - 1
+        else:
+            out = -(-size // stride)
+            total = max(0, (out - 1) * stride + k - size)
+        return total // 2, total - total // 2
+    return pad, pad
+
+
+class _ConvBase(Module):
+    def _init_params(self, w_shape, fan_in, fan_out, with_bias, bias_shape,
+                     init_weight=None, init_bias=None):
+        self.weight_init: InitializationMethod = RandomUniform()
+        self.bias_init: InitializationMethod = RandomUniform()
+        self._w_shape, self._fan_in, self._fan_out = w_shape, fan_in, fan_out
+        self._bias_shape = bias_shape
+        if init_weight is not None:
+            self.weight = Parameter(init_weight)
+        else:
+            self.weight = Parameter(self.weight_init.init(w_shape, fan_in=fan_in, fan_out=fan_out))
+        if with_bias:
+            if init_bias is not None:
+                self.bias = Parameter(init_bias)
+            else:
+                self.bias = Parameter(self.bias_init.init(bias_shape, fan_in=fan_in, fan_out=fan_out))
+
+    def reset(self):
+        self.weight = self.weight_init.init(self._w_shape, fan_in=self._fan_in, fan_out=self._fan_out)
+        if getattr(self, "with_bias", True) and "bias" in self.__dict__["_params"]:
+            self.bias = self.bias_init.init(self._bias_shape, fan_in=self._fan_in, fan_out=self._fan_out)
+
+
+class SpatialConvolution(_ConvBase):
+    """2-D convolution (``nn/SpatialConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
+                 propagate_back: bool = True, w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None, with_bias: bool = True,
+                 format: str = "NCHW"):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.with_bias = with_bias
+        self.format = format
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        fan_in = n_input_plane // n_group * kernel_h * kernel_w
+        fan_out = n_output_plane // n_group * kernel_h * kernel_w
+        self._init_params((n_output_plane, n_input_plane // n_group, kernel_h, kernel_w),
+                          fan_in, fan_out, with_bias, (n_output_plane,),
+                          init_weight, init_bias)
+
+    def update_output(self, input):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        if self.format == "NHWC":
+            dn = lax.conv_dimension_numbers(x.shape, self.weight.shape[2:] + (1, 1), ("NHWC", "HWIO", "NHWC"))
+            w = jnp.transpose(self.weight, (2, 3, 1, 0))  # OIHW -> HWIO
+            h_ax, w_ax, c_ax = 1, 2, 3
+        else:
+            dn = lax.conv_dimension_numbers(x.shape, self.weight.shape, ("NCHW", "OIHW", "NCHW"))
+            w = self.weight
+            h_ax, w_ax, c_ax = 2, 3, 1
+        pad_h = _pair_padding(self.pad_h, self.kernel_h, self.stride_h, x.shape[h_ax])
+        pad_w = _pair_padding(self.pad_w, self.kernel_w, self.stride_w, x.shape[w_ax])
+        y = lax.conv_general_dilated(
+            x, w.astype(x.dtype), (self.stride_h, self.stride_w), (pad_h, pad_w),
+            dimension_numbers=dn, feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.with_bias:
+            bshape = [1, 1, 1, 1]
+            bshape[c_ax] = self.n_output_plane
+            y = y + self.bias.reshape(bshape).astype(y.dtype)
+        return y[0] if squeeze else y
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Buffer-sharing variant in the reference
+    (``nn/SpatialShareConvolution.scala``); identical math — XLA owns
+    memory reuse here, so it is an alias."""
+
+
+class SpatialDilatedConvolution(_ConvBase):
+    """Atrous conv (``nn/SpatialDilatedConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        fan_in = n_input_plane * kh * kw
+        self._init_params((n_output_plane, n_input_plane, kh, kw), fan_in,
+                          n_output_plane * kh * kw, True, (n_output_plane,))
+
+    def update_output(self, input):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        dn = lax.conv_dimension_numbers(x.shape, self.weight.shape, ("NCHW", "OIHW", "NCHW"))
+        eff_kh = (self.kh - 1) * self.dilation_h + 1
+        eff_kw = (self.kw - 1) * self.dilation_w + 1
+        pad_h = _pair_padding(self.pad_h, eff_kh, self.dh, x.shape[2])
+        pad_w = _pair_padding(self.pad_w, eff_kw, self.dw, x.shape[3])
+        y = lax.conv_general_dilated(
+            x, self.weight.astype(x.dtype), (self.dh, self.dw), (pad_h, pad_w),
+            rhs_dilation=(self.dilation_h, self.dilation_w), dimension_numbers=dn,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        y = y + self.bias.reshape(1, -1, 1, 1).astype(y.dtype)
+        return y[0] if squeeze else y
+
+
+class SpatialFullConvolution(_ConvBase):
+    """Transposed ("de")convolution (``nn/SpatialFullConvolution.scala``).
+    Weight layout (in, out/group, kH, kW) as in Torch; implemented as an
+    input-dilated conv so XLA emits the standard transposed-conv kernel."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h, self.adj_w, self.adj_h = pad_w, pad_h, adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        fan_in = n_output_plane // n_group * kh * kw  # note: transposed fans
+        self._init_params((n_input_plane, n_output_plane // n_group, kh, kw),
+                          fan_in, n_input_plane * kh * kw,
+                          self.with_bias, (n_output_plane,))
+
+    def update_output(self, input):
+        x = input
+        if isinstance(x, (list, tuple)):  # (input, size-reference) table form
+            x = x[0]
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        # weight (I, O/g, kh, kw); conv_general with lhs_dilation implements
+        # the transpose: flip spatial dims and swap I/O per group.
+        w = self.weight
+        if self.n_group > 1:
+            w = w.reshape(self.n_group, self.n_input_plane // self.n_group,
+                          self.n_output_plane // self.n_group, self.kh, self.kw)
+            w = jnp.swapaxes(w, 1, 2).reshape(
+                self.n_output_plane, self.n_input_plane // self.n_group, self.kh, self.kw)
+        else:
+            w = jnp.swapaxes(w, 0, 1)
+        w = jnp.flip(w, axis=(2, 3))
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+        pad_h = (self.kh - 1 - self.pad_h, self.kh - 1 - self.pad_h + self.adj_h)
+        pad_w = (self.kw - 1 - self.pad_w, self.kw - 1 - self.pad_w + self.adj_w)
+        y = lax.conv_general_dilated(
+            x, w.astype(x.dtype), (1, 1), (pad_h, pad_w),
+            lhs_dilation=(self.dh, self.dw), dimension_numbers=dn,
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.with_bias:
+            y = y + self.bias.reshape(1, -1, 1, 1).astype(y.dtype)
+        return y[0] if squeeze else y
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input→output connection table
+    (``nn/SpatialConvolutionMap.scala``).  Expressed as a masked dense conv:
+    the sparse table becomes a 0/1 mask on a full OIHW kernel — dense MXU
+    matmuls beat gather-scatter on TPU for the tiny maps this layer is used
+    with (LeNet-era models)."""
+
+    def __init__(self, conn_table: np.ndarray, kw: int, kh: int,
+                 dw: int = 1, dh: int = 1, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        table = np.asarray(conn_table, np.int64)  # rows of (in, out), 0-based
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_input_plane = int(table[:, 0].max()) + 1
+        self.n_output_plane = int(table[:, 1].max()) + 1
+        mask = np.zeros((self.n_output_plane, self.n_input_plane, 1, 1), np.float32)
+        fan_ins = np.zeros((self.n_output_plane,), np.int64)
+        for i, o in table:
+            mask[o, i, 0, 0] = 1.0
+            fan_ins[o] += 1
+        self.register_buffer("mask", mask)
+        fan_in = int(fan_ins.max()) * kh * kw
+        self.weight_init = RandomUniform()
+        self.bias_init = RandomUniform()
+        self.weight = Parameter(self.weight_init.init(
+            (self.n_output_plane, self.n_input_plane, kh, kw), fan_in=fan_in))
+        self.bias = Parameter(self.bias_init.init((self.n_output_plane,), fan_in=fan_in))
+
+    def update_output(self, input):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        w = self.weight * self.mask
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+        y = lax.conv_general_dilated(
+            x, w.astype(x.dtype), (self.dh, self.dw),
+            ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            dimension_numbers=dn, preferred_element_type=jnp.float32).astype(x.dtype)
+        y = y + self.bias.reshape(1, -1, 1, 1).astype(y.dtype)
+        return y[0] if squeeze else y
+
+
+class TemporalConvolution(_ConvBase):
+    """1-D convolution over [batch, nInputFrame, inputFrameSize]
+    (``nn/TemporalConvolution.scala``)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1,
+                 propagate_back: bool = True, w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None):
+        super().__init__()
+        self.input_frame_size, self.output_frame_size = input_frame_size, output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        fan_in = input_frame_size * kernel_w
+        self._init_params((output_frame_size, input_frame_size, kernel_w), fan_in,
+                          output_frame_size * kernel_w, True, (output_frame_size,),
+                          init_weight, init_bias)
+
+    def update_output(self, input):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        # [B, T, C] -> conv over T with NWC layout
+        dn = lax.conv_dimension_numbers(x.shape, (self.kernel_w, 1, 1), ("NWC", "WIO", "NWC"))
+        w = jnp.transpose(self.weight, (2, 1, 0))  # OIW -> WIO
+        y = lax.conv_general_dilated(
+            x, w.astype(x.dtype), (self.stride_w,), ((0, 0),), dimension_numbers=dn,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        y = y + self.bias.astype(y.dtype)
+        return y[0] if squeeze else y
+
+
+class VolumetricConvolution(_ConvBase):
+    """3-D convolution over [batch, C, T, H, W]
+    (``nn/VolumetricConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int, d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0, with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        fan_in = n_input_plane * k_t * k_h * k_w
+        self._init_params((n_output_plane, n_input_plane, k_t, k_h, k_w), fan_in,
+                          n_output_plane * k_t * k_h * k_w, with_bias, (n_output_plane,))
+
+    def update_output(self, input):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        dn = lax.conv_dimension_numbers(x.shape, self.weight.shape, ("NCDHW", "OIDHW", "NCDHW"))
+        pads = [_pair_padding(self.pad_t, self.k_t, self.d_t, x.shape[2]),
+                _pair_padding(self.pad_h, self.k_h, self.d_h, x.shape[3]),
+                _pair_padding(self.pad_w, self.k_w, self.d_w, x.shape[4])]
+        y = lax.conv_general_dilated(
+            x, self.weight.astype(x.dtype), (self.d_t, self.d_h, self.d_w), pads,
+            dimension_numbers=dn, preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.with_bias:
+            y = y + self.bias.reshape(1, -1, 1, 1, 1).astype(y.dtype)
+        return y[0] if squeeze else y
+
+
+class VolumetricFullConvolution(_ConvBase):
+    """3-D transposed convolution (``nn/VolumetricFullConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int, d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.adj_t, self.adj_w, self.adj_h = adj_t, adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        fan_in = n_output_plane // n_group * k_t * k_h * k_w
+        self._init_params((n_input_plane, n_output_plane // n_group, k_t, k_h, k_w),
+                          fan_in, n_input_plane * k_t * k_h * k_w,
+                          self.with_bias, (n_output_plane,))
+
+    def update_output(self, input):
+        x = input
+        if isinstance(x, (list, tuple)):
+            x = x[0]
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        w = self.weight
+        if self.n_group > 1:
+            w = w.reshape(self.n_group, self.n_input_plane // self.n_group,
+                          self.n_output_plane // self.n_group, self.k_t, self.k_h, self.k_w)
+            w = jnp.swapaxes(w, 1, 2).reshape(
+                self.n_output_plane, self.n_input_plane // self.n_group,
+                self.k_t, self.k_h, self.k_w)
+        else:
+            w = jnp.swapaxes(w, 0, 1)
+        w = jnp.flip(w, axis=(2, 3, 4))
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+        pads = [(self.k_t - 1 - self.pad_t, self.k_t - 1 - self.pad_t + self.adj_t),
+                (self.k_h - 1 - self.pad_h, self.k_h - 1 - self.pad_h + self.adj_h),
+                (self.k_w - 1 - self.pad_w, self.k_w - 1 - self.pad_w + self.adj_w)]
+        y = lax.conv_general_dilated(
+            x, w.astype(x.dtype), (1, 1, 1), pads,
+            lhs_dilation=(self.d_t, self.d_h, self.d_w), dimension_numbers=dn,
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.with_bias:
+            y = y + self.bias.reshape(1, -1, 1, 1, 1).astype(y.dtype)
+        return y[0] if squeeze else y

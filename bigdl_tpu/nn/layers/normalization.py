@@ -1,0 +1,295 @@
+"""Normalization layers (SURVEY §2.5: BatchNormalization,
+SpatialBatchNormalization, SpatialCrossMapLRN, SpatialWithinChannelLRN,
+SpatialContrastiveNormalization, SpatialDivisiveNormalization,
+SpatialSubtractiveNormalization, Normalize) plus Dropout and L1Penalty
+(grouped with the reference's "Regularization" rows).
+
+BatchNorm running statistics are module *buffers*: the functional training
+step carries them in the state pytree and they advance under jit
+(``functional_call`` returns the updated state) — the JAX re-design of the
+reference's in-place ``runningMean``/``runningVar`` updates.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.module import Module, Parameter
+from bigdl_tpu.utils.rng import next_rng_id, require_rng
+
+__all__ = [
+    "BatchNormalization", "SpatialBatchNormalization", "SpatialCrossMapLRN",
+    "SpatialWithinChannelLRN", "SpatialContrastiveNormalization",
+    "SpatialDivisiveNormalization", "SpatialSubtractiveNormalization",
+    "Normalize", "Dropout", "L1Penalty",
+]
+
+
+class BatchNormalization(Module):
+    """Batch norm over [batch, feature] (``nn/BatchNormalization.scala``)."""
+
+    _feature_axis = 1
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, init_weight=None, init_bias=None):
+        super().__init__()
+        self.n_output, self.eps, self.momentum, self.affine = n_output, eps, momentum, affine
+        if affine:
+            self.weight = Parameter(init_weight if init_weight is not None
+                                    else jnp.ones((n_output,), jnp.float32))
+            self.bias = Parameter(init_bias if init_bias is not None
+                                  else jnp.zeros((n_output,), jnp.float32))
+        self.register_buffer("running_mean", jnp.zeros((n_output,), jnp.float32))
+        self.register_buffer("running_var", jnp.ones((n_output,), jnp.float32))
+
+    def reset(self):
+        if self.affine:
+            self.weight = jnp.ones((self.n_output,), jnp.float32)
+            self.bias = jnp.zeros((self.n_output,), jnp.float32)
+        self.running_mean = jnp.zeros((self.n_output,), jnp.float32)
+        self.running_var = jnp.ones((self.n_output,), jnp.float32)
+
+    def _stat_axes(self, ndim):
+        return tuple(a for a in range(ndim) if a != self._feature_axis)
+
+    def update_output(self, input):
+        axes = self._stat_axes(input.ndim)
+        shape = [1] * input.ndim
+        shape[self._feature_axis] = self.n_output
+        if self.training:
+            mean = jnp.mean(input, axis=axes)
+            var = jnp.var(input, axis=axes)
+            n = input.size // self.n_output
+            unbiased = var * n / max(n - 1, 1)
+            self.running_mean = (1 - self.momentum) * self.running_mean + self.momentum * mean
+            self.running_var = (1 - self.momentum) * self.running_var + self.momentum * unbiased
+        else:
+            mean, var = self.running_mean, self.running_var
+        inv = lax.rsqrt(var + self.eps).reshape(shape)
+        out = (input - mean.reshape(shape)) * inv
+        if self.affine:
+            out = out * self.weight.reshape(shape) + self.bias.reshape(shape)
+        return out.astype(input.dtype)
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """Batch norm over [batch, C, H, W] / [batch, H, W, C]
+    (``nn/SpatialBatchNormalization.scala``)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, init_weight=None, init_bias=None,
+                 format: str = "NCHW"):
+        super().__init__(n_output, eps, momentum, affine, init_weight, init_bias)
+        self.format = format
+
+    @property
+    def _feature_axis(self):  # type: ignore[override]
+        return 3 if self.format == "NHWC" else 1
+
+
+class SpatialCrossMapLRN(Module):
+    """AlexNet-style local response normalization across channels
+    (``nn/SpatialCrossMapLRN.scala``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, format: str = "NCHW"):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.format = format
+
+    def update_output(self, input):
+        c_ax = 3 if self.format == "NHWC" else 1
+        sq = input * input
+        half = (self.size - 1) // 2
+        dims, strides, pads = [1] * input.ndim, [1] * input.ndim, [(0, 0)] * input.ndim
+        dims[c_ax] = self.size
+        pads[c_ax] = (half, self.size - 1 - half)
+        window_sum = lax.reduce_window(sq, 0.0, lax.add, tuple(dims), tuple(strides), pads)
+        scale = self.k + window_sum * (self.alpha / self.size)
+        return input * jnp.power(scale, -self.beta)
+
+
+def _gaussian_kernel(size: int) -> np.ndarray:
+    sigma = 0.25 * size
+    xs = np.arange(size) - (size - 1) / 2.0
+    k = np.exp(-(xs**2) / (2 * sigma * sigma))
+    k2 = np.outer(k, k)
+    return (k2 / k2.sum()).astype(np.float32)
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN within each channel over a spatial window
+    (``nn/SpatialWithinChannelLRN.scala``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75):
+        super().__init__()
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def update_output(self, input):
+        half = (self.size - 1) // 2
+        dims, strides, pads = [1] * input.ndim, [1] * input.ndim, [(0, 0)] * input.ndim
+        for ax in (input.ndim - 2, input.ndim - 1):
+            dims[ax] = self.size
+            pads[ax] = (half, self.size - 1 - half)
+        window_mean = lax.reduce_window(input * input, 0.0, lax.add,
+                                        tuple(dims), tuple(strides), pads) / (self.size * self.size)
+        scale = 1.0 + window_mean * self.alpha
+        return input * jnp.power(scale, -self.beta)
+
+
+class _KernelSmoother:
+    """Shared helper: depthwise 2-D smoothing with a normalized kernel."""
+
+    @staticmethod
+    def smooth(x, kernel2d, n_plane):
+        k = jnp.asarray(kernel2d)[None, None, :, :]  # (1,1,kh,kw)
+        k = jnp.tile(k, (n_plane, 1, 1, 1))
+        kh, kw = kernel2d.shape
+        dn = lax.conv_dimension_numbers(x.shape, k.shape, ("NCHW", "OIHW", "NCHW"))
+        return lax.conv_general_dilated(
+            x, k.astype(x.dtype), (1, 1),
+            ((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)),
+            dimension_numbers=dn, feature_group_count=n_plane)
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract a kernel-weighted local mean (``nn/SpatialSubtractiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel: Optional[np.ndarray] = None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        k = np.asarray(kernel, np.float32) if kernel is not None else _gaussian_kernel(9)
+        if k.ndim == 1:
+            k = np.outer(k, k)
+        self.register_buffer("kernel", k / k.sum())
+
+    def _local_mean(self, x):
+        # mean across channels then smoothed spatially, with edge-coverage
+        # correction (the reference divides by the kernel mass actually inside)
+        mean_in = jnp.mean(x, axis=1, keepdims=True)
+        sm = _KernelSmoother.smooth(mean_in, self.kernel, 1)
+        ones = jnp.ones_like(mean_in)
+        coef = _KernelSmoother.smooth(ones, self.kernel, 1)
+        return sm / coef
+
+    def update_output(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        out = x - self._local_mean(x)
+        return out[0] if squeeze else out
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by the local standard deviation (``nn/SpatialDivisiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel: Optional[np.ndarray] = None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        k = np.asarray(kernel, np.float32) if kernel is not None else _gaussian_kernel(9)
+        if k.ndim == 1:
+            k = np.outer(k, k)
+        self.register_buffer("kernel", k / k.sum())
+        self.threshold, self.thresval = threshold, thresval
+
+    def update_output(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        mean_sq = jnp.mean(x * x, axis=1, keepdims=True)
+        sm = _KernelSmoother.smooth(mean_sq, self.kernel, 1)
+        ones = jnp.ones_like(mean_sq)
+        coef = _KernelSmoother.smooth(ones, self.kernel, 1)
+        local_std = jnp.sqrt(jnp.clip(sm / coef, 0.0))
+        std_mean = jnp.mean(local_std, axis=(2, 3), keepdims=True)
+        denom = jnp.maximum(local_std, std_mean)
+        denom = jnp.where(denom < self.threshold, self.thresval, denom)
+        out = x / denom
+        return out[0] if squeeze else out
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (``nn/SpatialContrastiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel: Optional[np.ndarray] = None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel, threshold, thresval)
+
+    def update_output(self, input):
+        return self.div.forward(self.sub.forward(input))
+
+
+class Normalize(Module):
+    """Lp-normalize along the feature dim (``nn/Normalize.scala``)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p, self.eps = p, eps
+
+    def update_output(self, input):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=-1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(input) ** self.p, axis=-1, keepdims=True) ** (1.0 / self.p)
+        return input / (norm + self.eps)
+
+
+class Dropout(Module):
+    """Inverted dropout (``nn/Dropout.scala``: scales by 1/(1-p) in train
+    when ``scale``)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False, scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+        self._rng_id = next_rng_id()
+
+    def set_p(self, p: float):
+        self.p = p
+        return self
+
+    def update_output(self, input):
+        if not self.training or self.p <= 0.0:
+            return input
+        key = require_rng(self._rng_id)
+        keep = jax.random.bernoulli(key, 1.0 - self.p, jnp.shape(input))
+        out = jnp.where(keep, input, 0.0)
+        if self.scale:
+            out = out / (1.0 - self.p)
+        return out.astype(input.dtype)
+
+
+class L1Penalty(Module):
+    """Identity forward that adds an L1 sparsity gradient in backward
+    (``nn/L1Penalty.scala``) — expressed as a custom VJP."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+    def update_output(self, input):
+        w = self.l1weight
+        if self.size_average:
+            w = w / input.size
+
+        @jax.custom_vjp
+        def penalty(x):
+            return x
+
+        def fwd(x):
+            return x, jnp.sign(x)
+
+        def bwd(sign, g):
+            return (g + w * sign,)
+
+        penalty.defvjp(fwd, bwd)
+        return penalty(input)

@@ -1,0 +1,222 @@
+"""Pooling layers (SURVEY §2.5: SpatialMaxPooling, SpatialAveragePooling,
+TemporalMaxPooling, VolumetricMaxPooling, RoiPooling).
+
+The reference's hand-written pooling loops (``nn/NNPrimitive.scala:594-972``)
+become ``lax.reduce_window`` — XLA lowers these to fused VPU reductions.
+Ceil-mode semantics (Torch) are reproduced with explicit asymmetric padding.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+__all__ = [
+    "SpatialMaxPooling", "SpatialAveragePooling", "TemporalMaxPooling",
+    "VolumetricMaxPooling", "VolumetricAveragePooling", "RoiPooling",
+]
+
+
+def _pool_out_size(size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
+    if ceil_mode:
+        out = int(math.ceil(float(size - k + 2 * pad) / stride)) + 1
+    else:
+        out = int(math.floor(float(size - k + 2 * pad) / stride)) + 1
+    if pad > 0 and (out - 1) * stride >= size + pad:
+        out -= 1  # Torch: last window must start inside the (left-)padded input
+    return out
+
+
+def _pool_padding(size: int, k: int, stride: int, pad: int, ceil_mode: bool):
+    out = _pool_out_size(size, k, stride, pad, ceil_mode)
+    needed = (out - 1) * stride + k
+    hi = max(0, needed - size - pad)
+    return (pad, hi), out
+
+
+class SpatialMaxPooling(Module):
+    """(``nn/SpatialMaxPooling.scala``); pad == -1 means SAME."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW"):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.format = format
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _spatial_axes(self, ndim):
+        if self.format == "NHWC":
+            return (ndim - 3, ndim - 2)
+        return (ndim - 2, ndim - 1)
+
+    def _reduce(self, x, init, op):
+        h_ax, w_ax = self._spatial_axes(x.ndim)
+        dims = [1] * x.ndim
+        strides = [1] * x.ndim
+        pads = [(0, 0)] * x.ndim
+        dims[h_ax], dims[w_ax] = self.kh, self.kw
+        strides[h_ax], strides[w_ax] = self.dh, self.dw
+        if self.pad_h == -1 or self.pad_w == -1:  # SAME
+            for ax, k, s in ((h_ax, self.kh, self.dh), (w_ax, self.kw, self.dw)):
+                out = -(-x.shape[ax] // s)
+                total = max(0, (out - 1) * s + k - x.shape[ax])
+                pads[ax] = (total // 2, total - total // 2)
+        else:
+            pads[h_ax], _ = _pool_padding(x.shape[h_ax], self.kh, self.dh, self.pad_h, self.ceil_mode)
+            pads[w_ax], _ = _pool_padding(x.shape[w_ax], self.kw, self.dw, self.pad_w, self.ceil_mode)
+        return lax.reduce_window(x, init, op, tuple(dims), tuple(strides), tuple(pads))
+
+    def update_output(self, input):
+        return self._reduce(input, -jnp.inf if jnp.issubdtype(input.dtype, jnp.floating)
+                            else jnp.iinfo(input.dtype).min, lax.max)
+
+
+class SpatialAveragePooling(SpatialMaxPooling):
+    """(``nn/SpatialAveragePooling.scala``)."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, global_pooling: bool = False,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True, format: str = "NCHW"):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, format)
+        self.ceil_mode = ceil_mode
+        self.global_pooling = global_pooling
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def update_output(self, input):
+        if self.global_pooling:
+            h_ax, w_ax = self._spatial_axes(input.ndim)
+            self.kh, self.kw = input.shape[h_ax], input.shape[w_ax]
+            self.dh, self.dw = self.kh, self.kw
+        s = self._reduce(input, 0.0, lax.add)
+        if not self.divide:
+            return s
+        if self.count_include_pad:
+            return s / (self.kh * self.kw)
+        ones = jnp.ones_like(input)
+        counts = self._reduce(ones, 0.0, lax.add)
+        return s / counts
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over [batch, time, feature]
+    (``nn/TemporalMaxPooling.scala``)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None):
+        super().__init__()
+        self.k_w, self.d_w = k_w, d_w or k_w
+
+    def update_output(self, input):
+        t_ax = input.ndim - 2
+        dims = [1] * input.ndim
+        strides = [1] * input.ndim
+        dims[t_ax], strides[t_ax] = self.k_w, self.d_w
+        return lax.reduce_window(input, -jnp.inf, lax.max, tuple(dims), tuple(strides),
+                                 [(0, 0)] * input.ndim)
+
+
+class VolumetricMaxPooling(Module):
+    """3-D max pooling over [batch, C, T, H, W]
+    (``nn/VolumetricMaxPooling.scala``)."""
+
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None, d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t or k_t, d_w or k_w, d_h or k_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.ceil_mode = False
+
+    def update_output(self, input):
+        ndim = input.ndim
+        t_ax, h_ax, w_ax = ndim - 3, ndim - 2, ndim - 1
+        dims, strides, pads = [1] * ndim, [1] * ndim, [(0, 0)] * ndim
+        for ax, k, d, p in ((t_ax, self.k_t, self.d_t, self.pad_t),
+                            (h_ax, self.k_h, self.d_h, self.pad_h),
+                            (w_ax, self.k_w, self.d_w, self.pad_w)):
+            dims[ax], strides[ax] = k, d
+            pads[ax], _ = _pool_padding(input.shape[ax], k, d, p, self.ceil_mode)
+        return lax.reduce_window(input, -jnp.inf, lax.max, tuple(dims), tuple(strides), pads)
+
+
+class VolumetricAveragePooling(VolumetricMaxPooling):
+    def update_output(self, input):
+        ndim = input.ndim
+        t_ax, h_ax, w_ax = ndim - 3, ndim - 2, ndim - 1
+        dims, strides, pads = [1] * ndim, [1] * ndim, [(0, 0)] * ndim
+        for ax, k, d, p in ((t_ax, self.k_t, self.d_t, self.pad_t),
+                            (h_ax, self.k_h, self.d_h, self.pad_h),
+                            (w_ax, self.k_w, self.d_w, self.pad_w)):
+            dims[ax], strides[ax] = k, d
+            pads[ax], _ = _pool_padding(input.shape[ax], k, d, p, self.ceil_mode)
+        s = lax.reduce_window(input, 0.0, lax.add, tuple(dims), tuple(strides), pads)
+        return s / (self.k_t * self.k_h * self.k_w)
+
+
+class RoiPooling(Module):
+    """Region-of-interest max pooling (``nn/RoiPooling.scala``).  Input is a
+    table (features [N,C,H,W], rois [R,5] of (batch_idx, x1, y1, x2, y2)).
+    Implemented with a dense one-hot projection per output cell so shapes
+    stay static under jit (no data-dependent slicing on TPU)."""
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float = 1.0):
+        super().__init__()
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def update_output(self, input):
+        data, rois = input
+        n, c, h, w = data.shape
+
+        def pool_one(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * self.spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * self.spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * self.spatial_scale).astype(jnp.int32)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1)
+            bin_w = roi_w.astype(jnp.float32) / self.pooled_w
+            bin_h = roi_h.astype(jnp.float32) / self.pooled_h
+            feat = data[b]  # (C, H, W)
+
+            ys = jnp.arange(h)
+            xs = jnp.arange(w)
+
+            def cell(py, px):
+                hstart = jnp.floor(py * bin_h).astype(jnp.int32) + y1
+                hend = jnp.ceil((py + 1) * bin_h).astype(jnp.int32) + y1
+                wstart = jnp.floor(px * bin_w).astype(jnp.int32) + x1
+                wend = jnp.ceil((px + 1) * bin_w).astype(jnp.int32) + x1
+                hstart, hend = jnp.clip(hstart, 0, h), jnp.clip(hend, 0, h)
+                wstart, wend = jnp.clip(wstart, 0, w), jnp.clip(wend, 0, w)
+                mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend)
+                        & (xs[None, :] >= wstart) & (xs[None, :] < wend))
+                empty = (hend <= hstart) | (wend <= wstart)
+                masked = jnp.where(mask[None, :, :], feat, -jnp.inf)
+                val = jnp.max(masked, axis=(1, 2))
+                return jnp.where(empty, 0.0, val)
+
+            py = jnp.arange(self.pooled_h)
+            px = jnp.arange(self.pooled_w)
+            return jax.vmap(lambda y: jax.vmap(lambda x: cell(y, x))(px))(py).transpose(2, 0, 1)
+
+        return jax.vmap(pool_one)(rois.astype(jnp.float32))

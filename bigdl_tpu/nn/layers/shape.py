@@ -1,0 +1,556 @@
+"""Shape/structure layers (SURVEY §2.5 "Shape/structure": Reshape,
+InferReshape, View, Transpose, Replicate, Padding, SpatialZeroPadding,
+Narrow, NarrowTable, Select, SelectTable, Index, MaskedSelect, Squeeze,
+Unsqueeze, Contiguous, Reverse, Pack, BifurcateSplitTable, SplitTable,
+JoinTable, FlattenTable, Max, Min, Mean, Sum, ResizeBilinear, Scale,
+Bottle) and the elementwise table ops (CAddTable, CSubTable, CMulTable,
+CDivTable, CMaxTable, CMinTable).
+
+Dim convention: 0-based Python axes (negative allowed), not the
+reference's 1-based Torch dims — idiomatic for a new JAX API.  Layers that
+batch-shift dims in the reference take an explicit axis instead.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = [
+    "Reshape", "InferReshape", "View", "Transpose", "Replicate", "Padding",
+    "SpatialZeroPadding", "Narrow", "NarrowTable", "Select", "SelectTable",
+    "Index", "MaskedSelect", "Squeeze", "Unsqueeze", "Contiguous", "Reverse",
+    "Pack", "SplitTable", "BifurcateSplitTable", "JoinTable", "FlattenTable",
+    "Max", "Min", "Mean", "Sum", "ResizeBilinear", "Scale", "Bottle",
+    "CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable", "CMinTable",
+]
+
+
+class Reshape(Module):
+    """Reshape the non-batch dims (``nn/Reshape.scala``); ``batch_mode=None``
+    auto-detects a leading batch dim like the reference."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+        self._n_elem = 1
+        for s in self.size:
+            self._n_elem *= s
+
+    def update_output(self, input):
+        batch = self.batch_mode
+        if batch is None:
+            batch = input.size != self._n_elem and input.shape[0] != 1 \
+                or (input.size == self._n_elem * input.shape[0] and input.size != self._n_elem)
+            batch = bool(batch) and input.size == self._n_elem * input.shape[0]
+        if batch:
+            return jnp.reshape(input, (input.shape[0],) + self.size)
+        return jnp.reshape(input, self.size)
+
+
+class InferReshape(Module):
+    """Reshape with -1 (inferred) and 0 (copy input dim) entries
+    (``nn/InferReshape.scala``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def update_output(self, input):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out: List[int] = []
+        for i, s in enumerate(self.size):
+            out.append(in_shape[i] if s == 0 else s)
+        total = 1
+        for d in in_shape:
+            total *= d
+        if -1 in out:
+            known = 1
+            for d in out:
+                if d != -1:
+                    known *= d
+            out[out.index(-1)] = total // known
+        if self.batch_mode:
+            return jnp.reshape(input, (input.shape[0],) + tuple(out))
+        return jnp.reshape(input, tuple(out))
+
+
+class View(Module):
+    """(``nn/View.scala``) — reshape allowing one -1."""
+
+    def __init__(self, *sizes: int):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (list, tuple)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(int(s) for s in sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n: int):
+        self.num_input_dims = n
+        return self
+
+    def update_output(self, input):
+        if self.num_input_dims and input.ndim > self.num_input_dims:
+            # batch-shift: keep the leading (ndim - num_input_dims) dims
+            lead = input.shape[: input.ndim - self.num_input_dims]
+            return jnp.reshape(input, lead + self.sizes)
+        n_elem = 1
+        for s in self.sizes:
+            if s != -1:
+                n_elem *= s
+        if -1 not in self.sizes and input.size != n_elem:
+            # leading batch dim preserved
+            return jnp.reshape(input, (-1,) + self.sizes)
+        return jnp.reshape(input, self.sizes)
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order (``nn/Transpose.scala``)."""
+
+    def __init__(self, permutations: Sequence[Sequence[int]]):
+        super().__init__()
+        self.permutations = tuple((int(a), int(b)) for a, b in permutations)
+
+    def update_output(self, input):
+        out = input
+        for a, b in self.permutations:
+            out = jnp.swapaxes(out, a, b)
+        return out
+
+
+class Replicate(Module):
+    """Insert a new axis of size ``n_features`` at ``dim`` by replication
+    (``nn/Replicate.scala``)."""
+
+    def __init__(self, n_features: int, dim: int = 0):
+        super().__init__()
+        self.n_features, self.dim = n_features, dim
+
+    def update_output(self, input):
+        out = jnp.expand_dims(input, self.dim)
+        reps = [1] * out.ndim
+        reps[self.dim] = self.n_features
+        return jnp.tile(out, reps)
+
+
+class Padding(Module):
+    """Pad ``pad`` entries (sign = side) along ``dim`` with ``value``
+    (``nn/Padding.scala``)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int = 0,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+        self.n_input_dim = n_input_dim
+
+    def update_output(self, input):
+        dim = self.dim
+        if self.n_input_dim and input.ndim > self.n_input_dim:
+            dim += input.ndim - self.n_input_dim  # batch shift
+        pads = [(0, 0)] * input.ndim
+        pads[dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, pads, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """(``nn/SpatialZeroPadding.scala``); negative pads crop."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int, pad_bottom: int):
+        super().__init__()
+        self.l, self.r, self.t, self.b = pad_left, pad_right, pad_top, pad_bottom
+
+    def update_output(self, input):
+        h_ax, w_ax = input.ndim - 2, input.ndim - 1
+        out = input
+        # crops first (negative pads)
+        sl = [slice(None)] * input.ndim
+        sl[h_ax] = slice(max(0, -self.t), input.shape[h_ax] - max(0, -self.b))
+        sl[w_ax] = slice(max(0, -self.l), input.shape[w_ax] - max(0, -self.r))
+        out = out[tuple(sl)]
+        pads = [(0, 0)] * input.ndim
+        pads[h_ax] = (max(0, self.t), max(0, self.b))
+        pads[w_ax] = (max(0, self.l), max(0, self.r))
+        return jnp.pad(out, pads)
+
+
+class Narrow(Module):
+    """Slice ``length`` entries from ``offset`` along ``dim``
+    (``nn/Narrow.scala``); length -1 = to the end."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def update_output(self, input):
+        length = self.length
+        if length < 0:
+            length = input.shape[self.dim] - self.offset + (length + 1)
+        sl = [slice(None)] * input.ndim
+        sl[self.dim] = slice(self.offset, self.offset + length)
+        return input[tuple(sl)]
+
+
+class NarrowTable(Module):
+    """Slice a table (``nn/NarrowTable.scala``)."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def update_output(self, input):
+        length = self.length
+        if length < 0:
+            length = len(input) - self.offset + (length + 1)
+        return list(input)[self.offset : self.offset + length]
+
+
+class Select(Module):
+    """Select index along dim, dropping the dim (``nn/Select.scala``);
+    negative index counts from the end."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def update_output(self, input):
+        return jnp.take(input, self.index % input.shape[self.dim], axis=self.dim)
+
+
+class SelectTable(Module):
+    """Select a table element (``nn/SelectTable.scala``)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def update_output(self, input):
+        return list(input)[self.index]
+
+
+class Index(Module):
+    """index_select along ``dim``: input = (tensor, indices)
+    (``nn/Index.scala``)."""
+
+    def __init__(self, dim: int):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        t, idx = input
+        return jnp.take(t, jnp.asarray(idx).astype(jnp.int32), axis=self.dim)
+
+
+class MaskedSelect(Module):
+    """input = (tensor, mask) -> 1-D of selected entries
+    (``nn/MaskedSelect.scala``).  Output size is data-dependent, so this
+    layer is **eager-only**; inside jit use ``jnp.where`` masking instead."""
+
+    def update_output(self, input):
+        t, mask = input
+        if isinstance(t, jax.core.Tracer):
+            raise RuntimeError(
+                "MaskedSelect has a data-dependent output shape and cannot be "
+                "jit-traced on TPU; restructure with jnp.where or run eagerly.")
+        return t[jnp.asarray(mask, bool)]
+
+
+class Squeeze(Module):
+    """(``nn/Squeeze.scala``)."""
+
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def update_output(self, input):
+        if self.dim is None:
+            return jnp.squeeze(input)
+        dim = self.dim
+        if self.num_input_dims and input.ndim > self.num_input_dims:
+            dim += input.ndim - self.num_input_dims
+        if input.shape[dim] == 1:
+            return jnp.squeeze(input, dim)
+        return input
+
+
+class Unsqueeze(Module):
+    """(``nn/Unsqueeze.scala``)."""
+
+    def __init__(self, pos: int, num_input_dims: int = 0):
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def update_output(self, input):
+        pos = self.pos
+        if self.num_input_dims and input.ndim > self.num_input_dims:
+            pos += input.ndim - self.num_input_dims
+        return jnp.expand_dims(input, pos)
+
+
+class Contiguous(Module):
+    """No-op on XLA (arrays are always dense) (``nn/Contiguous.scala``)."""
+
+    def update_output(self, input):
+        return input
+
+
+class Reverse(Module):
+    """Flip along ``dim`` (``nn/Reverse.scala``)."""
+
+    def __init__(self, dim: int = 0):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        return jnp.flip(input, self.dim)
+
+
+class Pack(Module):
+    """Stack a table of tensors along a new ``dim`` (``nn/Pack.scala``)."""
+
+    def __init__(self, dim: int = 0):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        if not isinstance(input, (list, tuple)):
+            input = [input]
+        return jnp.stack(list(input), axis=self.dim)
+
+
+class SplitTable(Module):
+    """Split a tensor along ``dim`` into a table (``nn/SplitTable.scala``)."""
+
+    def __init__(self, dim: int, num_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def update_output(self, input):
+        dim = self.dim
+        if self.num_input_dims and input.ndim > self.num_input_dims:
+            dim += input.ndim - self.num_input_dims
+        return [jnp.squeeze(s, dim) for s in jnp.split(input, input.shape[dim], axis=dim)]
+
+
+class BifurcateSplitTable(Module):
+    """Split into two halves along ``dim`` (``nn/BifurcateSplitTable.scala``)."""
+
+    def __init__(self, dim: int):
+        super().__init__()
+        self.dim = dim
+
+    def update_output(self, input):
+        half = input.shape[self.dim] // 2
+        a, b = jnp.split(input, [half], axis=self.dim)
+        return [a, b]
+
+
+class JoinTable(Module):
+    """Concatenate a table along ``dim`` (``nn/JoinTable.scala``)."""
+
+    def __init__(self, dim: int, n_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.n_input_dims = n_input_dims
+
+    def update_output(self, input):
+        dim = self.dim
+        first = input[0]
+        if self.n_input_dims and first.ndim > self.n_input_dims:
+            dim += first.ndim - self.n_input_dims
+        return jnp.concatenate(list(input), axis=dim)
+
+
+class FlattenTable(Module):
+    """Flatten nested tables (``nn/FlattenTable.scala``)."""
+
+    def update_output(self, input):
+        out: List = []
+
+        def walk(x):
+            if isinstance(x, (list, tuple)):
+                for e in x:
+                    walk(e)
+            else:
+                out.append(x)
+
+        walk(input)
+        return out
+
+
+class _Reduce(Module):
+    def __init__(self, dim: int = 0, num_input_dims: int = 0, keepdims: bool = False,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+        self.squeeze = squeeze
+
+    def _axis(self, input):
+        dim = self.dim
+        if self.num_input_dims and input.ndim > self.num_input_dims:
+            dim += input.ndim - self.num_input_dims
+        return dim
+
+
+class Max(_Reduce):
+    def update_output(self, input):
+        return jnp.max(input, axis=self._axis(input), keepdims=not self.squeeze)
+
+
+class Min(_Reduce):
+    def update_output(self, input):
+        return jnp.min(input, axis=self._axis(input), keepdims=not self.squeeze)
+
+
+class Mean(_Reduce):
+    def update_output(self, input):
+        return jnp.mean(input, axis=self._axis(input), keepdims=not self.squeeze)
+
+
+class Sum(_Reduce):
+    def __init__(self, dim: int = 0, num_input_dims: int = 0, size_average: bool = False,
+                 squeeze: bool = True):
+        super().__init__(dim, num_input_dims, squeeze=squeeze)
+        self.size_average = size_average
+
+    def update_output(self, input):
+        ax = self._axis(input)
+        out = jnp.sum(input, axis=ax, keepdims=not self.squeeze)
+        if self.size_average:
+            out = out / input.shape[ax]
+        return out
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize of NCHW/NHWC maps (``nn/ResizeBilinear.scala``)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, format: str = "NCHW"):
+        super().__init__()
+        self.output_height, self.output_width = output_height, output_width
+        self.align_corners = align_corners
+        self.format = format
+
+    def update_output(self, input):
+        if self.format == "NHWC":
+            shape = input.shape[:-3] + (self.output_height, self.output_width, input.shape[-1])
+        else:
+            shape = input.shape[:-2] + (self.output_height, self.output_width)
+        if not self.align_corners:
+            return jax.image.resize(input, shape, method="bilinear")
+        # align_corners: linear sample grid including both endpoints
+        h_ax = input.ndim - 3 if self.format == "NHWC" else input.ndim - 2
+        w_ax = h_ax + 1
+        ih, iw = input.shape[h_ax], input.shape[w_ax]
+        ys = jnp.linspace(0, ih - 1, self.output_height)
+        xs = jnp.linspace(0, iw - 1, self.output_width)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, ih - 1)
+        y1 = jnp.clip(y0 + 1, 0, ih - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, iw - 1)
+        x1 = jnp.clip(x0 + 1, 0, iw - 1)
+        wy = (ys - y0).reshape((-1, 1))
+        wx = (xs - x0).reshape((1, -1))
+
+        def gather(h_idx, w_idx):
+            g = jnp.take(input, h_idx, axis=h_ax)
+            return jnp.take(g, w_idx, axis=w_ax)
+
+        # broadcast weights to the spatial axes
+        wshape = [1] * input.ndim
+        wshape[h_ax], wshape[w_ax] = self.output_height, self.output_width
+        wy_b = jnp.broadcast_to(wy, (self.output_height, self.output_width)).reshape(wshape)
+        wx_b = jnp.broadcast_to(wx, (self.output_height, self.output_width)).reshape(wshape)
+        top = gather(y0, x0) * (1 - wx_b) + gather(y0, x1) * wx_b
+        bot = gather(y1, x0) * (1 - wx_b) + gather(y1, x1) * wx_b
+        return top * (1 - wy_b) + bot * wy_b
+
+
+class Scale(Module):
+    """Channel-wise affine y = w*x + b with learnable w, b of ``size``
+    (``nn/Scale.scala``: CMul + CAdd fused)."""
+
+    def __init__(self, size: Sequence[int]):
+        super().__init__()
+        self.size = tuple(size)
+        self.weight = Parameter(jnp.ones(self.size, jnp.float32))
+        self.bias = Parameter(jnp.zeros(self.size, jnp.float32))
+
+    def update_output(self, input):
+        return input * self.weight + self.bias
+
+
+class Bottle(Module):
+    """Flatten leading dims, apply inner module, restore
+    (``nn/Bottle.scala``)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = 2):
+        super().__init__()
+        self.inner = module
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def update_output(self, input):
+        if input.ndim <= self.n_input_dim:
+            return self.inner.forward(input)
+        lead = input.shape[: input.ndim - self.n_input_dim + 1]
+        flat = input.reshape((-1,) + input.shape[input.ndim - self.n_input_dim + 1 :])
+        out = self.inner.forward(flat)
+        return out.reshape(lead + out.shape[1:])
+
+
+# ---------------------------- table elementwise ---------------------------
+
+class CAddTable(Module):
+    """(``nn/CAddTable.scala``)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def update_output(self, input):
+        out = input[0]
+        for t in input[1:]:
+            out = out + t
+        return out
+
+
+class CSubTable(Module):
+    def update_output(self, input):
+        return input[0] - input[1]
+
+
+class CMulTable(Module):
+    def update_output(self, input):
+        out = input[0]
+        for t in input[1:]:
+            out = out * t
+        return out
+
+
+class CDivTable(Module):
+    def update_output(self, input):
+        return input[0] / input[1]
+
+
+class CMaxTable(Module):
+    def update_output(self, input):
+        out = input[0]
+        for t in input[1:]:
+            out = jnp.maximum(out, t)
+        return out
+
+
+class CMinTable(Module):
+    def update_output(self, input):
+        out = input[0]
+        for t in input[1:]:
+            out = jnp.minimum(out, t)
+        return out

@@ -425,6 +425,10 @@ def _clear_outputs(module: Module):
     for m in module.modules():
         m.__dict__["output"] = None
         m.__dict__["grad_input"] = None
+        # clear any module-specific trace-time scratch (e.g. Recurrent's
+        # final scan state) so tracers never leak out of functional_call
+        for attr in m.__dict__.get("_trace_attrs", ()):
+            m.__dict__[attr] = None
 
 
 def functional_call(
