@@ -1,0 +1,10 @@
+"""bigdl_tpu.dataset — data pipeline (SURVEY §2.6)."""
+
+from bigdl_tpu.dataset.dataset import (  # noqa: F401
+    AbstractDataSet, DataSet, DistributedDataSet, LocalDataSet,
+)
+from bigdl_tpu.dataset.minibatch import MiniBatch  # noqa: F401
+from bigdl_tpu.dataset.sample import PaddingParam, Sample  # noqa: F401
+from bigdl_tpu.dataset.transformer import (  # noqa: F401
+    ChainedTransformer, SampleToMiniBatch, Transformer,
+)

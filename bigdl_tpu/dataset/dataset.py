@@ -1,0 +1,160 @@
+"""DataSet abstractions (``dataset/DataSet.scala``).
+
+The reference's split is Local (iterator on one JVM) vs Distributed (RDD,
+one cached partition per node).  On TPU the split collapses: the host
+pipeline produces **global batches** and the training step shards them over
+the mesh's data axis (``jax.device_put`` with a NamedSharding) — the moral
+equivalent of ``CachedDistriDataSet``'s one-partition-per-node caching +
+per-partition shuffle (``DataSet.scala:240``), without a user-visible
+cluster.
+
+- ``LocalDataSet``: in-memory array of elements + transformer chain.
+- ``DistributedDataSet``: LocalDataSet + per-host sharding metadata for
+  multi-host SPMD (each process keeps ``1/num_hosts`` of the data, the
+  reference's per-node partition).
+- factories ``DataSet.array``, ``DataSet.image_folder``, ``DataSet.generator``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.rng import RNG
+
+__all__ = ["AbstractDataSet", "LocalDataSet", "DistributedDataSet", "DataSet"]
+
+
+class AbstractDataSet:
+    """(``dataset/DataSet.scala:46``)."""
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        raise NotImplementedError
+
+    def __rshift__(self, transformer: Transformer) -> "AbstractDataSet":
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """(``dataset/DataSet.scala:110``)."""
+
+    def __init__(self, data, transformers: Optional[List[Transformer]] = None):
+        self._data = list(data) if not isinstance(data, np.ndarray) else data
+        self._transformers = transformers or []
+        self._perm = np.arange(len(self._data))
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self):
+        self._perm = RNG.permutation(len(self._data))
+        return self
+
+    def transform(self, transformer: Transformer) -> "LocalDataSet":
+        ds = LocalDataSet.__new__(LocalDataSet)
+        ds._data = self._data
+        ds._perm = self._perm
+        ds._transformers = self._transformers + [transformer]
+        return ds
+
+    def _raw_iter(self, train: bool) -> Iterator:
+        if train:
+            while True:
+                for i in self._perm:
+                    yield self._data[i]
+                self.shuffle()
+        else:
+            for i in range(len(self._data)):
+                yield self._data[i]
+
+    def data(self, train: bool = False) -> Iterator:
+        it: Iterator = self._raw_iter(train)
+        for t in self._transformers:
+            it = t(it)
+        return it
+
+
+class DistributedDataSet(LocalDataSet):
+    """Multi-host SPMD dataset (``dataset/DataSet.scala:164`` capability):
+    each host process reads only its shard of the records, so the global
+    batch assembled across processes covers the whole dataset — the
+    reference's one-cached-partition-per-node layout."""
+
+    def __init__(self, data, num_shards: int = 1, shard_index: int = 0,
+                 transformers: Optional[List[Transformer]] = None):
+        data = list(data) if not isinstance(data, np.ndarray) else data
+        self.num_shards, self.shard_index = num_shards, shard_index
+        shard = data[shard_index::num_shards] if num_shards > 1 else data
+        super().__init__(shard, transformers)
+        self._global_size = len(data)
+
+    def global_size(self) -> int:
+        return self._global_size
+
+    def transform(self, transformer: Transformer) -> "DistributedDataSet":
+        ds = DistributedDataSet.__new__(DistributedDataSet)
+        ds._data = self._data
+        ds._perm = self._perm
+        ds.num_shards, ds.shard_index = self.num_shards, self.shard_index
+        ds._global_size = self._global_size
+        ds._transformers = self._transformers + [transformer]
+        return ds
+
+
+class _GeneratorDataSet(AbstractDataSet):
+    """Wrap a callable producing fresh iterators (streaming sources)."""
+
+    def __init__(self, gen: Callable[[bool], Iterable], size: int,
+                 transformers: Optional[List[Transformer]] = None):
+        self._gen = gen
+        self._size = size
+        self._transformers = transformers or []
+
+    def size(self):
+        return self._size
+
+    def shuffle(self):
+        return self
+
+    def transform(self, transformer):
+        return _GeneratorDataSet(self._gen, self._size,
+                                 self._transformers + [transformer])
+
+    def data(self, train: bool = False):
+        it = iter(self._gen(train))
+        for t in self._transformers:
+            it = t(it)
+        return it
+
+
+class DataSet:
+    """Factories (``object DataSet``, ``dataset/DataSet.scala:319``)."""
+
+    @staticmethod
+    def array(data, num_shards: int = 1, shard_index: int = 0) -> LocalDataSet:
+        if num_shards > 1:
+            return DistributedDataSet(data, num_shards, shard_index)
+        return LocalDataSet(data)
+
+    @staticmethod
+    def generator(gen: Callable[[bool], Iterable], size: int) -> AbstractDataSet:
+        return _GeneratorDataSet(gen, size)
+
+    @staticmethod
+    def image_folder(path: str, scale_to: int = 256) -> LocalDataSet:
+        """ImageFolder.paths equivalent: <path>/<label>/xxx.jpg layout."""
+        from bigdl_tpu.dataset.image import LocalImageFiles
+
+        return LocalDataSet(LocalImageFiles.read_paths(path))

@@ -1,0 +1,55 @@
+"""Sample — one labeled record (``dataset/Sample.scala:31,126``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["Sample", "PaddingParam"]
+
+
+class Sample:
+    """Feature tensor(s) + label tensor(s), host-side numpy."""
+
+    def __init__(self, features, labels=None):
+        self.features: List[np.ndarray] = [np.asarray(f) for f in _as_list(features)]
+        self.labels: List[np.ndarray] = [np.asarray(l) for l in _as_list(labels)] \
+            if labels is not None else []
+
+    @property
+    def feature(self) -> np.ndarray:
+        return self.features[0]
+
+    @property
+    def label(self) -> np.ndarray:
+        return self.labels[0]
+
+    def feature_size(self):
+        return [f.shape for f in self.features]
+
+    def label_size(self):
+        return [l.shape for l in self.labels]
+
+    def __repr__(self):
+        return f"Sample(features={[f.shape for f in self.features]}, " \
+               f"labels={[l.shape for l in self.labels]})"
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class PaddingParam:
+    """Padding strategy (``dataset/MiniBatch.scala`` PaddingParam /
+    DefaultPadding): pad value per tensor and optional fixed target length
+    along the first (time) axis."""
+
+    def __init__(self, padding_value: float = 0.0,
+                 fixed_length: Optional[int] = None):
+        self.padding_value = padding_value
+        self.fixed_length = fixed_length

@@ -3,13 +3,17 @@ TemporalMaxPooling, VolumetricMaxPooling, RoiPooling).
 
 The reference's hand-written pooling loops (``nn/NNPrimitive.scala:594-972``)
 become ``lax.reduce_window`` — XLA lowers these to fused VPU reductions.
-Ceil-mode semantics (Torch) are reproduced with explicit asymmetric padding.
+Ceil-mode semantics (Torch) are reproduced with explicit asymmetric padding;
+average-pooling divisors follow the reference exactly: declared padding
+counts when ``count_include_pad`` but ceil-overflow padding never does
+(``SpatialAveragePooling.scala:133-135`` clips the pool size at the
+declared pad).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +27,12 @@ __all__ = [
 ]
 
 
+def _max_init(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf
+    return jnp.iinfo(dtype).min
+
+
 def _pool_out_size(size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
     if ceil_mode:
         out = int(math.ceil(float(size - k + 2 * pad) / stride)) + 1
@@ -33,15 +43,68 @@ def _pool_out_size(size: int, k: int, stride: int, pad: int, ceil_mode: bool) ->
     return out
 
 
-def _pool_padding(size: int, k: int, stride: int, pad: int, ceil_mode: bool):
+def _axis_padding(size: int, k: int, stride: int, pad: int, ceil_mode: bool
+                  ) -> Tuple[int, int, int]:
+    """(lo, hi, declared_hi): hi includes ceil-overflow; declared_hi is the
+    part of hi within the user-declared padding (counts toward the
+    count_include_pad divisor)."""
+    if pad == -1:  # SAME
+        out = -(-size // stride)
+        total = max(0, (out - 1) * stride + k - size)
+        lo, hi = total // 2, total - total // 2
+        return lo, hi, hi
     out = _pool_out_size(size, k, stride, pad, ceil_mode)
     needed = (out - 1) * stride + k
     hi = max(0, needed - size - pad)
-    return (pad, hi), out
+    return pad, hi, min(hi, pad)
 
 
-class SpatialMaxPooling(Module):
-    """(``nn/SpatialMaxPooling.scala``); pad == -1 means SAME."""
+class _PoolBase(Module):
+    """Shared window plumbing over the trailing spatial axes."""
+
+    ceil_mode = False
+
+    def _axes_spec(self, ndim) -> List[Tuple[int, int, int, int]]:
+        """[(axis, k, stride, pad), ...] — subclasses define."""
+        raise NotImplementedError
+
+    def _window(self, x):
+        dims = [1] * x.ndim
+        strides = [1] * x.ndim
+        pads = [(0, 0)] * x.ndim
+        declared = [(0, 0)] * x.ndim
+        for ax, k, d, p in self._axes_spec(x.ndim):
+            dims[ax], strides[ax] = k, d
+            lo, hi, dh = _axis_padding(x.shape[ax], k, d, p, self.ceil_mode)
+            pads[ax] = (lo, hi)
+            declared[ax] = (lo, dh)
+        return tuple(dims), tuple(strides), pads, declared
+
+    def _max(self, x):
+        dims, strides, pads, _ = self._window(x)
+        return lax.reduce_window(x, _max_init(x.dtype), lax.max, dims, strides, pads)
+
+    def _avg(self, x, count_include_pad: bool, divide: bool = True):
+        dims, strides, pads, declared = self._window(x)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if not divide:
+            return s
+        if count_include_pad:
+            # ones over data + declared padding; ceil-overflow region is zero
+            ones = jnp.ones(x.shape, x.dtype)
+            ones = jnp.pad(ones, declared, constant_values=1.0)
+            extra = [(p[0] - d[0], p[1] - d[1]) for p, d in zip(pads, declared)]
+            ones = jnp.pad(ones, extra, constant_values=0.0)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                       [(0, 0)] * x.ndim)
+        else:
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return s / counts
+
+
+class SpatialMaxPooling(_PoolBase):
+    """(``nn/SpatialMaxPooling.scala``); pad == -1 means SAME (per axis)."""
 
     def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
                  pad_w: int = 0, pad_h: int = 0, format: str = "NCHW"):
@@ -60,31 +123,16 @@ class SpatialMaxPooling(Module):
         self.ceil_mode = False
         return self
 
-    def _spatial_axes(self, ndim):
+    def _axes_spec(self, ndim):
         if self.format == "NHWC":
-            return (ndim - 3, ndim - 2)
-        return (ndim - 2, ndim - 1)
-
-    def _reduce(self, x, init, op):
-        h_ax, w_ax = self._spatial_axes(x.ndim)
-        dims = [1] * x.ndim
-        strides = [1] * x.ndim
-        pads = [(0, 0)] * x.ndim
-        dims[h_ax], dims[w_ax] = self.kh, self.kw
-        strides[h_ax], strides[w_ax] = self.dh, self.dw
-        if self.pad_h == -1 or self.pad_w == -1:  # SAME
-            for ax, k, s in ((h_ax, self.kh, self.dh), (w_ax, self.kw, self.dw)):
-                out = -(-x.shape[ax] // s)
-                total = max(0, (out - 1) * s + k - x.shape[ax])
-                pads[ax] = (total // 2, total - total // 2)
+            h_ax, w_ax = ndim - 3, ndim - 2
         else:
-            pads[h_ax], _ = _pool_padding(x.shape[h_ax], self.kh, self.dh, self.pad_h, self.ceil_mode)
-            pads[w_ax], _ = _pool_padding(x.shape[w_ax], self.kw, self.dw, self.pad_w, self.ceil_mode)
-        return lax.reduce_window(x, init, op, tuple(dims), tuple(strides), tuple(pads))
+            h_ax, w_ax = ndim - 2, ndim - 1
+        return [(h_ax, self.kh, self.dh, self.pad_h),
+                (w_ax, self.kw, self.dw, self.pad_w)]
 
     def update_output(self, input):
-        return self._reduce(input, -jnp.inf if jnp.issubdtype(input.dtype, jnp.floating)
-                            else jnp.iinfo(input.dtype).min, lax.max)
+        return self._max(input)
 
 
 class SpatialAveragePooling(SpatialMaxPooling):
@@ -102,20 +150,14 @@ class SpatialAveragePooling(SpatialMaxPooling):
 
     def update_output(self, input):
         if self.global_pooling:
-            h_ax, w_ax = self._spatial_axes(input.ndim)
+            spec = self._axes_spec(input.ndim)
+            (h_ax, *_), (w_ax, *_) = spec
             self.kh, self.kw = input.shape[h_ax], input.shape[w_ax]
             self.dh, self.dw = self.kh, self.kw
-        s = self._reduce(input, 0.0, lax.add)
-        if not self.divide:
-            return s
-        if self.count_include_pad:
-            return s / (self.kh * self.kw)
-        ones = jnp.ones_like(input)
-        counts = self._reduce(ones, 0.0, lax.add)
-        return s / counts
+        return self._avg(input, self.count_include_pad, self.divide)
 
 
-class TemporalMaxPooling(Module):
+class TemporalMaxPooling(_PoolBase):
     """1-D max pooling over [batch, time, feature]
     (``nn/TemporalMaxPooling.scala``)."""
 
@@ -123,16 +165,14 @@ class TemporalMaxPooling(Module):
         super().__init__()
         self.k_w, self.d_w = k_w, d_w or k_w
 
+    def _axes_spec(self, ndim):
+        return [(ndim - 2, self.k_w, self.d_w, 0)]
+
     def update_output(self, input):
-        t_ax = input.ndim - 2
-        dims = [1] * input.ndim
-        strides = [1] * input.ndim
-        dims[t_ax], strides[t_ax] = self.k_w, self.d_w
-        return lax.reduce_window(input, -jnp.inf, lax.max, tuple(dims), tuple(strides),
-                                 [(0, 0)] * input.ndim)
+        return self._max(input)
 
 
-class VolumetricMaxPooling(Module):
+class VolumetricMaxPooling(_PoolBase):
     """3-D max pooling over [batch, C, T, H, W]
     (``nn/VolumetricMaxPooling.scala``)."""
 
@@ -145,37 +185,38 @@ class VolumetricMaxPooling(Module):
         self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
         self.ceil_mode = False
 
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _axes_spec(self, ndim):
+        return [(ndim - 3, self.k_t, self.d_t, self.pad_t),
+                (ndim - 2, self.k_h, self.d_h, self.pad_h),
+                (ndim - 1, self.k_w, self.d_w, self.pad_w)]
+
     def update_output(self, input):
-        ndim = input.ndim
-        t_ax, h_ax, w_ax = ndim - 3, ndim - 2, ndim - 1
-        dims, strides, pads = [1] * ndim, [1] * ndim, [(0, 0)] * ndim
-        for ax, k, d, p in ((t_ax, self.k_t, self.d_t, self.pad_t),
-                            (h_ax, self.k_h, self.d_h, self.pad_h),
-                            (w_ax, self.k_w, self.d_w, self.pad_w)):
-            dims[ax], strides[ax] = k, d
-            pads[ax], _ = _pool_padding(input.shape[ax], k, d, p, self.ceil_mode)
-        return lax.reduce_window(input, -jnp.inf, lax.max, tuple(dims), tuple(strides), pads)
+        return self._max(input)
 
 
 class VolumetricAveragePooling(VolumetricMaxPooling):
+    """(``nn/VolumetricAveragePooling.scala``)."""
+
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None, d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 count_include_pad: bool = True):
+        super().__init__(k_t, k_w, k_h, d_t, d_w, d_h, pad_t, pad_w, pad_h)
+        self.count_include_pad = count_include_pad
+
     def update_output(self, input):
-        ndim = input.ndim
-        t_ax, h_ax, w_ax = ndim - 3, ndim - 2, ndim - 1
-        dims, strides, pads = [1] * ndim, [1] * ndim, [(0, 0)] * ndim
-        for ax, k, d, p in ((t_ax, self.k_t, self.d_t, self.pad_t),
-                            (h_ax, self.k_h, self.d_h, self.pad_h),
-                            (w_ax, self.k_w, self.d_w, self.pad_w)):
-            dims[ax], strides[ax] = k, d
-            pads[ax], _ = _pool_padding(input.shape[ax], k, d, p, self.ceil_mode)
-        s = lax.reduce_window(input, 0.0, lax.add, tuple(dims), tuple(strides), pads)
-        return s / (self.k_t * self.k_h * self.k_w)
+        return self._avg(input, self.count_include_pad)
 
 
 class RoiPooling(Module):
     """Region-of-interest max pooling (``nn/RoiPooling.scala``).  Input is a
     table (features [N,C,H,W], rois [R,5] of (batch_idx, x1, y1, x2, y2)).
-    Implemented with a dense one-hot projection per output cell so shapes
-    stay static under jit (no data-dependent slicing on TPU)."""
+    Implemented with dense masks per output cell so shapes stay static under
+    jit (no data-dependent slicing on TPU)."""
 
     def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float = 1.0):
         super().__init__()

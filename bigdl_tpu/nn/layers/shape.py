@@ -45,9 +45,14 @@ class Reshape(Module):
     def update_output(self, input):
         batch = self.batch_mode
         if batch is None:
-            batch = input.size != self._n_elem and input.shape[0] != 1 \
-                or (input.size == self._n_elem * input.shape[0] and input.size != self._n_elem)
-            batch = bool(batch) and input.size == self._n_elem * input.shape[0]
+            # auto-detect (Reshape.scala:61-63): treat as batched when the
+            # leading dim looks like a batch; batch-size-1 inputs keep their
+            # batch dim when they carry one extra dim over the target size
+            if input.size == self._n_elem * input.shape[0] and (
+                    input.shape[0] != 1 or input.ndim == len(self.size) + 1):
+                batch = input.size != self._n_elem or input.shape[0] == 1
+            else:
+                batch = False
         if batch:
             return jnp.reshape(input, (input.shape[0],) + self.size)
         return jnp.reshape(input, self.size)
@@ -392,7 +397,8 @@ class _Reduce(Module):
         super().__init__()
         self.dim = dim
         self.num_input_dims = num_input_dims
-        self.squeeze = squeeze
+        # keepdims=True and squeeze=False both mean "retain the reduced dim"
+        self.squeeze = squeeze and not keepdims
 
     def _axis(self, input):
         dim = self.dim

@@ -192,7 +192,22 @@ class Module:
             out, _ = functional_call(self, p, inp, rng=replay_key)
             return out
 
+        # functional_call clears trace scratch (_last_rng_key, Recurrent
+        # state, ...) — snapshot and restore so eager state survives
+        # repeated backward calls and get_hidden_state() after backward
+        scratch = []
+        for m in self.modules():
+            entry = {}
+            if "_last_rng_key" in m.__dict__:
+                entry["_last_rng_key"] = m.__dict__["_last_rng_key"]
+            for attr in m.__dict__.get("_trace_attrs", ()):
+                entry[attr] = m.__dict__.get(attr)
+            scratch.append(entry)
+
         out, vjp = jax.vjp(fn, params, input)
+        for m, entry in zip(self.modules(), scratch):
+            for attr, val in entry.items():
+                m.__dict__[attr] = val
         tangent = jax.tree.map(
             lambda o, g: jnp.asarray(g, o.dtype) if g is not None else jnp.zeros_like(o),
             out,
@@ -425,6 +440,9 @@ def _clear_outputs(module: Module):
     for m in module.modules():
         m.__dict__["output"] = None
         m.__dict__["grad_input"] = None
+        # forward() may have stored a replay key; under trace it is a tracer
+        # (jax.random.key stages to the ambient trace) and must not survive
+        m.__dict__.pop("_last_rng_key", None)
         # clear any module-specific trace-time scratch (e.g. Recurrent's
         # final scan state) so tracers never leak out of functional_call
         for attr in m.__dict__.get("_trace_attrs", ()):

@@ -1,0 +1,10 @@
+"""bigdl_tpu.optim — optimization layer (SURVEY §2.8)."""
+
+from bigdl_tpu.optim.optim_method import *  # noqa: F401,F403
+from bigdl_tpu.optim.trigger import Trigger  # noqa: F401
+from bigdl_tpu.optim.validation import *  # noqa: F401,F403
+from bigdl_tpu.optim.regularizer import *  # noqa: F401,F403
+from bigdl_tpu.optim.metrics import Metrics  # noqa: F401
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, DistriOptimizer  # noqa: F401
+from bigdl_tpu.optim.evaluator import Evaluator  # noqa: F401
+from bigdl_tpu.optim.predictor import LocalPredictor, Predictor  # noqa: F401

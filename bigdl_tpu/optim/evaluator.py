@@ -1,0 +1,39 @@
+"""Evaluator (``optim/Evaluator.scala:37`` + Local/DistriValidator):
+run validation methods over a dataset with a compiled forward."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.parallel.train_step import EvalStep
+
+__all__ = ["Evaluator"]
+
+
+class Evaluator:
+    def __init__(self, model, batch_size: int = 32, mesh=None):
+        self.model = model
+        self.batch_size = batch_size
+        self.mesh = mesh
+
+    def evaluate(self, dataset, methods: Sequence[ValidationMethod]
+                 ) -> List[Tuple[ValidationResult, ValidationMethod]]:
+        if isinstance(dataset, (list, tuple)):
+            dataset = DataSet.array(list(dataset)).transform(
+                SampleToMiniBatch(self.batch_size))
+        step = EvalStep(self.model, mesh=self.mesh)
+        was_training = self.model.is_training()
+        self.model.evaluate()
+        try:
+            results: Optional[List[ValidationResult]] = None
+            for batch in dataset.data(train=False):
+                out = step.run(batch.get_input())
+                rs = [m(out, batch.get_target()) for m in methods]
+                results = rs if results is None else [a + b for a, b in zip(results, rs)]
+        finally:
+            if was_training:
+                self.model.train()
+        return list(zip(results or [], methods))

@@ -1,0 +1,46 @@
+"""Per-stage training metrics (``optim/Metrics.scala:31-130``).
+
+The reference aggregates timings via Spark accumulators across executors;
+here a host-side accumulator keyed by stage name (the SPMD step is one
+device program, so per-stage wall times come from the host loop and,
+optionally, jax profiling)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+__all__ = ["Metrics"]
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._scalars: Dict[str, List[float]] = {}
+
+    def set(self, name: str, value: float):
+        with self._lock:
+            self._scalars[name] = [float(value)]
+
+    def add(self, name: str, value: float):
+        with self._lock:
+            self._scalars.setdefault(name, []).append(float(value))
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            vals = self._scalars.get(name, [])
+            return sum(vals) / len(vals) if vals else 0.0
+
+    def reset(self):
+        with self._lock:
+            self._scalars.clear()
+
+    def summary(self, unit_scale: float = 1.0) -> str:
+        """Pretty printer mirroring ``Metrics.summary``."""
+        with self._lock:
+            lines = ["========== Metrics Summary =========="]
+            for name, vals in sorted(self._scalars.items()):
+                mean = sum(vals) / len(vals) if vals else 0.0
+                lines.append(f"{name} : {mean * unit_scale:.6f} s")
+            lines.append("=====================================")
+            return "\n".join(lines)

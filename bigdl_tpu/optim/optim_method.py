@@ -1,0 +1,556 @@
+"""Optimization methods (SURVEY §2.8: SGD + LR-schedule family, Adam,
+Adamax, Adagrad, Adadelta, RMSprop, LBFGS; base ``optim/OptimMethod.scala``).
+
+Each method has a **pure functional core** — ``init_state(params)`` and
+``update(grads, params, state) -> (new_params, new_state)`` over pytrees —
+which the training step jits/pjits (state shards with the parameters for
+the ZeRO-1 layout).  The reference's imperative
+``optimize(feval, parameter)`` API is kept as a thin host-side shell for
+parity (used by LBFGS-style workflows and tests).
+
+Hyper-state the reference keeps in the mutable ``state`` Table
+(evalCounter, epoch, ...) lives in the state pytree as scalars so schedules
+compile into the step.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "OptimMethod", "SGD", "Adam", "Adamax", "Adagrad", "Adadelta", "RMSprop",
+    "LBFGS", "Default", "Poly", "Step", "MultiStep", "EpochDecay", "EpochStep",
+    "NaturalExp", "Exponential", "Plateau", "Warmup", "SequentialSchedule",
+    "EpochSchedule", "Regime",
+]
+
+Pytree = Any
+
+
+def _tree_map(f, *trees):
+    return jax.tree.map(f, *trees)
+
+
+class OptimMethod:
+    """Base (``optim/OptimMethod.scala:38``)."""
+
+    def __init__(self):
+        self.state: Dict[str, Any] = {}
+
+    # -- functional core ---------------------------------------------------
+    def init_state(self, params: Pytree) -> Pytree:
+        return {"neval": jnp.zeros((), jnp.int32), "epoch": jnp.ones((), jnp.int32)}
+
+    def update(self, grads: Pytree, params: Pytree, state: Pytree) -> Tuple[Pytree, Pytree]:
+        raise NotImplementedError
+
+    # -- imperative parity shell ------------------------------------------
+    def optimize(self, feval: Callable, parameter):
+        """feval(x) -> (loss, grad); updates ``parameter`` in the reference
+        API style and returns (new_parameter, [loss])."""
+        if "func_state" not in self.state:
+            self.state["func_state"] = self.init_state(parameter)
+        loss, grad = feval(parameter)
+        new_p, self.state["func_state"] = self.update(grad, parameter, self.state["func_state"])
+        return new_p, [loss]
+
+    def get_learning_rate(self) -> float:
+        return float(getattr(self, "learning_rate", 0.0))
+
+    def clear_history(self):
+        self.state = {}
+
+    def get_hyper_parameter(self) -> str:
+        return f"Current learning rate is {self.get_learning_rate()}."
+
+    def clone(self) -> "OptimMethod":
+        return copy.deepcopy(self)
+
+    def save(self, path: str, overwrite: bool = False):
+        from bigdl_tpu.utils.serializer import save_optim_method
+
+        save_optim_method(self, path, overwrite)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "OptimMethod":
+        from bigdl_tpu.utils.serializer import load_optim_method
+
+        return load_optim_method(path)
+
+
+# --------------------------------------------------------------------------
+# Learning-rate schedules (optim/SGD.scala:198-534)
+# --------------------------------------------------------------------------
+
+class LearningRateSchedule:
+    """Maps (base_lr, state) -> lr.  Pure; compiles into the train step."""
+
+    def rate(self, base_lr, state) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """Torch default: lr / (1 + neval * lrd) (``SGD.scala`` Default)."""
+
+    def __init__(self, learning_rate_decay: float = 0.0):
+        self.learning_rate_decay = learning_rate_decay
+
+    def rate(self, base_lr, state):
+        return base_lr / (1.0 + state["neval"].astype(jnp.float32) * self.learning_rate_decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max_iter)^power; 0 beyond max_iteration."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def rate(self, base_lr, state):
+        it = state["neval"].astype(jnp.float32)
+        frac = jnp.clip(1.0 - it / self.max_iteration, 0.0, 1.0)
+        return base_lr * jnp.power(frac, self.power)
+
+
+class Step(LearningRateSchedule):
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def rate(self, base_lr, state):
+        k = jnp.floor_divide(state["neval"], self.step_size).astype(jnp.float32)
+        return base_lr * jnp.power(self.gamma, k)
+
+
+class MultiStep(LearningRateSchedule):
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes = tuple(step_sizes)
+        self.gamma = gamma
+
+    def rate(self, base_lr, state):
+        it = state["neval"]
+        k = jnp.zeros((), jnp.float32)
+        for s in self.step_sizes:
+            k = k + (it >= s).astype(jnp.float32)
+        return base_lr * jnp.power(self.gamma, k)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay_fn(epoch); decay_fn is host-side (static per epoch)."""
+
+    def __init__(self, decay_fn: Callable[[int], float]):
+        self.decay_fn = decay_fn
+
+    def rate(self, base_lr, state):
+        # epoch is a traced scalar; the decay function is arbitrary Python,
+        # so we evaluate it via a small pure_callback-free table is not
+        # possible generally — instead treat epoch as slowly-varying and
+        # compute host-side when concrete, else via lax.stop_gradient trick.
+        ep = state["epoch"]
+        if isinstance(ep, jax.core.Tracer):
+            # fall back: schedules using arbitrary python decay recompile per
+            # epoch via the static_epoch mechanism in the train step
+            ep_val = int(state.get("static_epoch", 1))
+        else:
+            ep_val = int(ep)
+        return base_lr * (0.1 ** self.decay_fn(ep_val))
+
+
+class EpochStep(LearningRateSchedule):
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def rate(self, base_lr, state):
+        k = jnp.floor_divide(state["epoch"] - 1, self.step_size).astype(jnp.float32)
+        return base_lr * jnp.power(self.gamma, k)
+
+
+class NaturalExp(LearningRateSchedule):
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def rate(self, base_lr, state):
+        p = jnp.floor_divide(state["neval"], self.decay_step).astype(jnp.float32)
+        return base_lr * jnp.exp(-self.gamma * p)
+
+
+class Exponential(LearningRateSchedule):
+    def __init__(self, decay_step: int, decay_rate: float, staircase: bool = False):
+        self.decay_step, self.decay_rate, self.staircase = decay_step, decay_rate, staircase
+
+    def rate(self, base_lr, state):
+        p = state["neval"].astype(jnp.float32) / self.decay_step
+        if self.staircase:
+            p = jnp.floor(p)
+        return base_lr * jnp.power(self.decay_rate, p)
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp over delta for warmup_iteration steps, then the chained
+    schedule (SGD.scala Warmup/SequentialSchedule)."""
+
+    def __init__(self, delta: float, warmup_iteration: int,
+                 after: Optional[LearningRateSchedule] = None):
+        self.delta, self.warmup_iteration, self.after = delta, warmup_iteration, after
+
+    def rate(self, base_lr, state):
+        it = state["neval"].astype(jnp.float32)
+        warm = base_lr + self.delta * it
+        after = self.after.rate(base_lr + self.delta * self.warmup_iteration, state) \
+            if self.after else base_lr + self.delta * self.warmup_iteration
+        return jnp.where(it < self.warmup_iteration, warm, after)
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for a number of iterations."""
+
+    def __init__(self):
+        self.schedules = []  # (schedule, duration)
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def rate(self, base_lr, state):
+        it = state["neval"]
+        offset = 0
+        out = None
+        for i, (sched, dur) in enumerate(self.schedules):
+            shifted = dict(state)
+            shifted["neval"] = jnp.maximum(it - offset, 0)
+            r = sched.rate(base_lr, shifted)
+            last = i == len(self.schedules) - 1
+            # the last schedule also covers iterations past the total budget
+            sel = (it >= offset) if last else (it >= offset) & (it < offset + dur)
+            out = r if out is None else jnp.where(sel, r, out)
+            offset += dur
+        return out
+
+
+class Regime:
+    def __init__(self, start_epoch: int, end_epoch: int, config: Dict[str, Any]):
+        self.start_epoch, self.end_epoch, self.config = start_epoch, end_epoch, config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Per-epoch-range hyper config (``SGD.scala`` EpochSchedule)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)
+
+    def rate(self, base_lr, state):
+        ep = state["epoch"]
+        out = jnp.asarray(base_lr, jnp.float32)
+        for r in self.regimes:
+            lr = jnp.asarray(r.config.get("learning_rate", base_lr), jnp.float32)
+            sel = (ep >= r.start_epoch) & (ep <= r.end_epoch)
+            out = jnp.where(sel, lr, out)
+        return out
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce-on-plateau; driven host-side from validation scores
+    (``SGD.scala`` Plateau).  The factor lives in state['plateau_factor']."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1, patience: int = 10,
+                 mode: str = "min", epsilon: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon, self.cooldown, self.min_lr = mode, epsilon, cooldown, min_lr
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+        self.current_factor = 1.0
+
+    def on_metric(self, value: float):
+        """Host-side hook called by the Optimizer after validation."""
+        better = (self._best is None
+                  or (self.mode == "min" and value < self._best - self.epsilon)
+                  or (self.mode == "max" and value > self._best + self.epsilon))
+        if better:
+            self._best = value
+            self._wait = 0
+        elif self._cool > 0:
+            self._cool -= 1
+        else:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self.current_factor *= self.factor
+                self._wait = 0
+                self._cool = self.cooldown
+
+    def rate(self, base_lr, state):
+        return jnp.maximum(base_lr * state.get("plateau_factor", self.current_factor), self.min_lr)
+
+
+# --------------------------------------------------------------------------
+# Methods
+# --------------------------------------------------------------------------
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/dampening/weightDecay and the schedule
+    family (``optim/SGD.scala``)."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0, dampening: Optional[float] = None,
+                 nesterov: bool = False, learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = dampening if dampening is not None else (0.0 if nesterov else 0.0)
+        self.nesterov = nesterov
+        if nesterov and (self.momentum <= 0 or self.dampening != 0):
+            raise ValueError("Nesterov momentum requires momentum > 0 and dampening = 0")
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        if self.momentum > 0:
+            st["velocity"] = _tree_map(jnp.zeros_like, params)
+        return st
+
+    def update(self, grads, params, state):
+        lr = self.schedule.rate(self.learning_rate, state)
+        wd = self.weight_decay
+        if wd != 0:
+            grads = _tree_map(lambda g, p: g + wd * p, grads, params)
+        new_state = dict(state)
+        if self.momentum > 0:
+            vel = _tree_map(lambda v, g: self.momentum * v + (1.0 - self.dampening) * g,
+                            state["velocity"], grads)
+            new_state["velocity"] = vel
+            if self.nesterov:
+                step = _tree_map(lambda g, v: g + self.momentum * v, grads, vel)
+            else:
+                step = vel
+        else:
+            step = grads
+        new_p = _tree_map(lambda p, s: p - lr * s, params, step)
+        new_state["neval"] = state["neval"] + 1
+        return new_p, new_state
+
+
+class Adam(OptimMethod):
+    """(``optim/Adam.scala``)."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        st["m"] = _tree_map(jnp.zeros_like, params)
+        st["v"] = _tree_map(jnp.zeros_like, params)
+        return st
+
+    def update(self, grads, params, state):
+        t = state["neval"].astype(jnp.float32) + 1.0
+        lr = self.learning_rate / (1.0 + state["neval"].astype(jnp.float32) * self.learning_rate_decay)
+        b1, b2 = self.beta1, self.beta2
+        m = _tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+        new_p = _tree_map(
+            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            params, m, v)
+        return new_p, {**state, "m": m, "v": v, "neval": state["neval"] + 1}
+
+
+class Adamax(OptimMethod):
+    """(``optim/Adamax.scala``)."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        st["m"] = _tree_map(jnp.zeros_like, params)
+        st["u"] = _tree_map(jnp.zeros_like, params)
+        return st
+
+    def update(self, grads, params, state):
+        t = state["neval"].astype(jnp.float32) + 1.0
+        b1 = self.beta1
+        m = _tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = _tree_map(lambda u, g: jnp.maximum(self.beta2 * u, jnp.abs(g) + self.epsilon),
+                      state["u"], grads)
+        lr_t = self.learning_rate / (1.0 - jnp.power(b1, t))
+        new_p = _tree_map(lambda p, m_, u_: p - lr_t * m_ / u_, params, m, u)
+        return new_p, {**state, "m": m, "u": u, "neval": state["neval"] + 1}
+
+
+class Adagrad(OptimMethod):
+    """(``optim/Adagrad.scala``)."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        st["accum"] = _tree_map(jnp.zeros_like, params)
+        return st
+
+    def update(self, grads, params, state):
+        lr = self.learning_rate / (1.0 + state["neval"].astype(jnp.float32) * self.learning_rate_decay)
+        if self.weight_decay != 0:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        accum = _tree_map(lambda a, g: a + g * g, state["accum"], grads)
+        new_p = _tree_map(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
+                          params, grads, accum)
+        return new_p, {**state, "accum": accum, "neval": state["neval"] + 1}
+
+
+class Adadelta(OptimMethod):
+    """(``optim/Adadelta.scala``)."""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__()
+        self.decay_rate, self.epsilon = decay_rate, epsilon
+        self.learning_rate = 1.0
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        st["accum"] = _tree_map(jnp.zeros_like, params)
+        st["delta_accum"] = _tree_map(jnp.zeros_like, params)
+        return st
+
+    def update(self, grads, params, state):
+        rho, eps = self.decay_rate, self.epsilon
+        accum = _tree_map(lambda a, g: rho * a + (1 - rho) * g * g, state["accum"], grads)
+        delta = _tree_map(lambda d, a, g: jnp.sqrt(d + eps) / jnp.sqrt(a + eps) * g,
+                          state["delta_accum"], accum, grads)
+        d_accum = _tree_map(lambda d, dl: rho * d + (1 - rho) * dl * dl,
+                            state["delta_accum"], delta)
+        new_p = _tree_map(lambda p, dl: p - dl, params, delta)
+        return new_p, {**state, "accum": accum, "delta_accum": d_accum,
+                       "neval": state["neval"] + 1}
+
+
+class RMSprop(OptimMethod):
+    """(``optim/RMSprop.scala``)."""
+
+    def __init__(self, learning_rate: float = 1e-2, learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.decay_rate, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        st["rms"] = _tree_map(jnp.zeros_like, params)
+        return st
+
+    def update(self, grads, params, state):
+        lr = self.learning_rate / (1.0 + state["neval"].astype(jnp.float32) * self.learning_rate_decay)
+        rho = self.decay_rate
+        rms = _tree_map(lambda r, g: rho * r + (1 - rho) * g * g, state["rms"], grads)
+        new_p = _tree_map(lambda p, g, r: p - lr * g / (jnp.sqrt(r) + self.epsilon),
+                          params, grads, rms)
+        return new_p, {**state, "rms": rms, "neval": state["neval"] + 1}
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS with optional line search
+    (``optim/LBFGS.scala``, ``optim/LineSearch.scala``).  Host-side eager
+    over a flat parameter vector — the reference uses it for full-batch
+    problems, never in the distributed hot loop."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9, n_correction: int = 100,
+                 learning_rate: float = 1.0, line_search: bool = False):
+        super().__init__()
+        self.max_iter, self.tol_fun, self.tol_x = max_iter, tol_fun, tol_x
+        self.max_eval = max_eval if max_eval is not None else max_iter * 1.25
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+
+    def optimize(self, feval, x):
+        x = jnp.asarray(x)
+        old_dirs, old_steps = [], []
+        loss, g = feval(x)
+        losses = [float(loss)]
+        d = -g
+        g_old, f_old = g, loss
+        H_diag = 1.0
+        n_eval = 1
+        for _ in range(self.max_iter):
+            if jnp.max(jnp.abs(g)) <= self.tol_fun:
+                break
+            # two-loop recursion
+            if old_dirs:
+                q = -g
+                al = []
+                ro = [1.0 / jnp.dot(y, s) for y, s in zip(old_dirs, old_steps)]
+                for i in range(len(old_dirs) - 1, -1, -1):
+                    a = ro[i] * jnp.dot(old_steps[i], q)
+                    al.append(a)
+                    q = q - a * old_dirs[i]
+                al.reverse()
+                r = q * H_diag
+                for i in range(len(old_dirs)):
+                    b = ro[i] * jnp.dot(old_dirs[i], r)
+                    r = r + (al[i] - b) * old_steps[i]
+                d = r
+            t = self.learning_rate if old_dirs else min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) * self.learning_rate
+            gtd = jnp.dot(g, d)
+            if float(gtd) > -self.tol_x:
+                break
+            # step (optionally with backtracking line search)
+            if self.line_search:
+                f_new, g_new, t, ls_evals = _backtrack(feval, x, t, d, loss, gtd)
+                n_eval += ls_evals
+                x = x + t * d
+            else:
+                x = x + t * d
+                f_new, g_new = feval(x)
+                n_eval += 1
+            y = g_new - g
+            s = t * d
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(old_dirs) == self.n_correction:
+                    old_dirs.pop(0)
+                    old_steps.pop(0)
+                old_dirs.append(y)
+                old_steps.append(s)
+                H_diag = ys / float(jnp.dot(y, y))
+            f_old, g_old = loss, g
+            loss, g = f_new, g_new
+            losses.append(float(loss))
+            if n_eval >= self.max_eval:
+                break
+            if float(jnp.max(jnp.abs(t * d))) <= self.tol_x:
+                break
+            if abs(float(loss - f_old)) < self.tol_fun:
+                break
+        return x, losses
+
+
+def _backtrack(feval, x, t, d, f0, gtd, c1: float = 1e-4, max_ls: int = 25):
+    evals = 0
+    for _ in range(max_ls):
+        f_new, g_new = feval(x + t * d)
+        evals += 1
+        if float(f_new) <= float(f0) + c1 * t * float(gtd):
+            return f_new, g_new, t, evals
+        t = t * 0.5
+    return f_new, g_new, t, evals

@@ -1,0 +1,58 @@
+"""Predictor (``optim/Predictor.scala:35``, ``optim/LocalPredictor.scala:37``):
+batched inference over datasets/arrays with a compiled forward."""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.parallel.train_step import EvalStep
+
+__all__ = ["LocalPredictor", "Predictor"]
+
+
+class LocalPredictor:
+    def __init__(self, model, batch_size: int = 32, mesh=None):
+        self.model = model
+        self.batch_size = batch_size
+        self.mesh = mesh
+
+    def _batches(self, data):
+        from bigdl_tpu.dataset.dataset import AbstractDataSet
+
+        if isinstance(data, (list, tuple)) and data and isinstance(data[0], Sample):
+            ds = DataSet.array(list(data)).transform(SampleToMiniBatch(self.batch_size))
+            yield from ds.data(train=False)
+        elif isinstance(data, AbstractDataSet):
+            yield from data.data(train=False)
+        else:  # raw array: batch it
+            arr = np.asarray(data)
+            for i in range(0, len(arr), self.batch_size):
+                from bigdl_tpu.dataset.minibatch import MiniBatch
+
+                yield MiniBatch([arr[i:i + self.batch_size]])
+
+    def predict(self, data) -> np.ndarray:
+        step = EvalStep(self.model, mesh=self.mesh)
+        was_training = self.model.is_training()
+        self.model.evaluate()
+        try:
+            outs: List[np.ndarray] = []
+            for batch in self._batches(data):
+                outs.append(np.asarray(step.run(batch.get_input())))
+        finally:
+            if was_training:
+                self.model.train()
+        return np.concatenate(outs) if outs else np.zeros((0,))
+
+    def predict_class(self, data, one_based: bool = False) -> np.ndarray:
+        out = self.predict(data)
+        pred = out.argmax(axis=-1)
+        return pred + 1 if one_based else pred
+
+
+Predictor = LocalPredictor

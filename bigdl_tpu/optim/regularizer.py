@@ -1,0 +1,65 @@
+"""Regularizers (``optim/Regularizer.scala:30-178``: L1L2Regularizer,
+L1Regularizer, L2Regularizer).
+
+The reference applies regularization inside each layer's
+``accGradParameters``; here the training step applies it when assembling
+gradients — per-parameter, honoring each layer's ``w_regularizer`` /
+``b_regularizer`` configuration."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Regularizer", "L1L2Regularizer", "L1Regularizer", "L2Regularizer"]
+
+
+class Regularizer:
+    def __init__(self):
+        self.is_enabled = True
+
+    def enable(self):
+        self.is_enabled = True
+        return self
+
+    def disable(self):
+        self.is_enabled = False
+        return self
+
+    def grad(self, param):
+        """Gradient contribution d(penalty)/d(param)."""
+        raise NotImplementedError
+
+    def loss(self, param):
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        super().__init__()
+        self.l1, self.l2 = l1, l2
+
+    def grad(self, param):
+        g = 0.0
+        if self.l1 != 0:
+            g = g + self.l1 * jnp.sign(param)
+        if self.l2 != 0:
+            g = g + self.l2 * param
+        return g
+
+    def loss(self, param):
+        total = 0.0
+        if self.l1 != 0:
+            total = total + self.l1 * jnp.sum(jnp.abs(param))
+        if self.l2 != 0:
+            total = total + 0.5 * self.l2 * jnp.sum(param * param)
+        return total
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1, l2=0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l1=0.0, l2=l2)

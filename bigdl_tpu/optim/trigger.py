@@ -1,0 +1,60 @@
+"""Triggers — composable fire/stop predicates over driver state
+(``optim/Trigger.scala:26-127``: everyEpoch, severalIteration, maxEpoch,
+maxIteration, maxScore, minLoss; plus and/or combinators)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+__all__ = ["Trigger"]
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[Dict], bool]):
+        self._fn = fn
+
+    def __call__(self, state: Dict) -> bool:
+        return self._fn(state)
+
+    # -- factories ---------------------------------------------------------
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        """Fires when the training loop crosses an epoch boundary."""
+        holder = {"last": -1}
+
+        def fn(state):
+            ep = state.get("epoch", 1)
+            if state.get("_epoch_boundary", False) and ep != holder["last"]:
+                holder["last"] = ep
+                return True
+            return False
+
+        return Trigger(fn)
+
+    @staticmethod
+    def several_iteration(interval: int) -> "Trigger":
+        return Trigger(lambda s: s.get("neval", 0) % interval == 0 and s.get("neval", 0) > 0)
+
+    @staticmethod
+    def max_epoch(max_: int) -> "Trigger":
+        return Trigger(lambda s: s.get("epoch", 1) > max_)
+
+    @staticmethod
+    def max_iteration(max_: int) -> "Trigger":
+        return Trigger(lambda s: s.get("neval", 0) >= max_)
+
+    @staticmethod
+    def max_score(max_: float) -> "Trigger":
+        return Trigger(lambda s: s.get("score", float("-inf")) > max_)
+
+    @staticmethod
+    def min_loss(min_: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss", float("inf")) < min_)
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: all(t(s) for t in triggers))
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: any(t(s) for t in triggers))

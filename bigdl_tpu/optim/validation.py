@@ -1,0 +1,163 @@
+"""Validation methods & results (``optim/ValidationMethod.scala``:
+Top1Accuracy, Top5Accuracy, Loss, MAE, TreeNNAccuracy; results merge with
+``+`` for distributed/batched aggregation)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "ValidationResult", "AccuracyResult", "LossResult", "ValidationMethod",
+    "Top1Accuracy", "Top5Accuracy", "Loss", "MAE", "TreeNNAccuracy",
+]
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int):
+        self.correct, self.count = int(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {n}, accuracy: {acc:.6f})"
+
+    def __eq__(self, other):
+        return isinstance(other, AccuracyResult) and \
+            (self.correct, self.count) == (other.correct, other.count)
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss, self.count = float(loss), int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        mean, n = self.result()
+        return f"Loss(loss: {self.loss:.6f}, count: {n}, mean: {mean:.6f})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+def _to_classes(output, one_based: bool):
+    out = np.asarray(output)
+    if out.ndim == 1:
+        out = out[None, :]
+    pred = out.argmax(axis=-1)
+    return pred + 1 if one_based else pred
+
+
+class Top1Accuracy(ValidationMethod):
+    """(``ValidationMethod.scala:170``)."""
+
+    name = "Top1Accuracy"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        pred = _to_classes(output, self.one_based)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        return AccuracyResult(int((pred == t).sum()), t.size)
+
+
+class Top5Accuracy(ValidationMethod):
+    """(``ValidationMethod.scala:218``)."""
+
+    name = "Top5Accuracy"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        if out.ndim == 1:
+            out = out[None, :]
+        top5 = np.argsort(-out, axis=-1)[:, :5]
+        if self.one_based:
+            top5 = top5 + 1
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        correct = int((top5 == t[:, None]).any(axis=1).sum())
+        return AccuracyResult(correct, t.size)
+
+
+class Loss(ValidationMethod):
+    """Mean criterion loss (``ValidationMethod.scala:312``)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        if criterion is None:
+            from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+            criterion = ClassNLLCriterion()
+        self.criterion = criterion
+
+    def __call__(self, output, target):
+        loss = float(self.criterion.update_output(jnp.asarray(output), jnp.asarray(target)))
+        n = np.asarray(output).shape[0]
+        return LossResult(loss * n, n)
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error on argmax-decoded predictions vs targets
+    (``ValidationMethod.scala:332``)."""
+
+    name = "MAE"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        pred = _to_classes(output, self.one_based).astype(np.float64)
+        t = np.asarray(target).reshape(-1).astype(np.float64)
+        return LossResult(float(np.abs(pred - t).sum()), t.size)
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy on the root-node prediction of a tree output
+    (``ValidationMethod.scala:118``): output [batch, nodes, classes],
+    evaluated at the first (root) node."""
+
+    name = "TreeNNAccuracy"
+
+    def __init__(self, one_based: bool = False):
+        self.one_based = one_based
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        root = out[:, 0, :] if out.ndim == 3 else out
+        pred = root.argmax(axis=-1)
+        if self.one_based:
+            pred = pred + 1
+        t = np.asarray(target)
+        t = t[:, 0] if t.ndim == 2 else t.reshape(-1)
+        return AccuracyResult(int((pred == t.astype(np.int64)).sum()), pred.size)

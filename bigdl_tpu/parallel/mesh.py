@@ -1,0 +1,56 @@
+"""Device-mesh utilities (the TPU-native replacement for the reference's
+Engine node/core topology, ``utils/Engine.scala:313-418``).
+
+Axes convention:
+- ``data``  — data parallelism (the reference's only axis)
+- ``model`` — tensor parallelism (new capability, TPU-first)
+- ``seq``   — sequence/context parallelism for long sequences (ring
+  attention / all-to-all; new capability)
+- ``pipe``  — pipeline stages
+- ``expert``— expert parallelism for MoE layers
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["make_mesh", "data_sharding", "replicated", "DATA_AXIS",
+           "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = (DATA_AXIS,),
+              devices=None):
+    """Build a ``jax.sharding.Mesh``.  ``shape=None`` puts all devices on
+    the first axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_sharding(mesh, ndim: int, batch_axes: Sequence[str] = (DATA_AXIS,)):
+    """NamedSharding that splits the leading axis over the data axes."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * ndim
+    spec[0] = batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

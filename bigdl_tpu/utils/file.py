@@ -1,0 +1,62 @@
+"""Object persistence (``utils/File.scala:25``: save/load to local FS,
+HDFS, S3).  TPU-native equivalent: local FS + GCS-style ``gs://`` via
+fsspec when available (gated — zero-egress environments fall back to a
+clear error), with atomic local writes."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any
+
+__all__ = ["save", "load", "is_remote"]
+
+
+def is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def _open(path: str, mode: str):
+    if is_remote(path):
+        try:
+            import fsspec  # type: ignore
+
+            return fsspec.open(path, mode).open()
+        except ImportError as e:
+            raise RuntimeError(
+                f"remote path {path!r} requires fsspec/gcsfs which are not "
+                f"installed in this environment") from e
+    return open(path, mode)
+
+
+def save(obj: Any, path: str, overwrite: bool = False):
+    """(``File.save``) — atomic for local paths."""
+    if not overwrite and _exists(path):
+        raise FileExistsError(f"{path} exists and overwrite=False")
+    if is_remote(path):
+        with _open(path, "wb") as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path: str) -> Any:
+    with _open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def _exists(path: str) -> bool:
+    if is_remote(path):
+        return False
+    return os.path.exists(path)

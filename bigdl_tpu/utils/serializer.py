@@ -1,0 +1,105 @@
+"""Module / optim-method persistence (SURVEY §2.9).
+
+The reference has two formats: Java serialization (default checkpoints,
+``AbstractModule.save`` / ``Module.load``) and a versioned protobuf module
+format (``utils/serializer/*.scala`` + ``bigdl.proto``).  Here:
+
+- **Checkpoint format** (this module): the full module object is pickled
+  with every device array converted to numpy — host-portable, no device
+  state, loadable without model code changes.  Optim methods likewise.
+- **Structured format**: ``save_state_dict``/``load_state_dict_file``
+  persist only ``{path: array}`` (npz), the analogue of weight-only
+  protobuf round-trips, usable across re-implementations of a model.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+from bigdl_tpu.utils import file as File
+
+__all__ = [
+    "save_module", "load_module", "save_optim_method", "load_optim_method",
+    "save_state_dict", "load_state_dict_file",
+]
+
+
+def _to_numpy_tree(obj):
+    import jax
+
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree.map(conv, obj)
+
+
+class _NumpyfyingPickler(pickle.Pickler):
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):  # numpy-ify jax arrays on the fly
+        import jax
+
+        if isinstance(obj, jax.Array):
+            return (np.asarray, (np.asarray(obj),))
+        return NotImplemented
+
+
+def _dumps(obj) -> bytes:
+    buf = io.BytesIO()
+    _NumpyfyingPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
+    return buf.getvalue()
+
+
+def save_module(module, path: str, overwrite: bool = False):
+    File.save(_dumps(module), path, overwrite)
+
+
+def load_module(path: str):
+    blob = File.load(path)
+    module = pickle.loads(blob)
+    _rehydrate(module)
+    return module
+
+
+def _rehydrate(module):
+    """numpy arrays -> jnp on first use happens lazily via jnp.asarray in
+    forward paths; convert eagerly for params/buffers so dtypes are exact."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.module import Module
+
+    if not isinstance(module, Module):
+        return
+    for m in module.modules():
+        for table in ("_params", "_buffers"):
+            t = m.__dict__.get(table, {})
+            for k, v in list(t.items()):
+                t[k] = jnp.asarray(v)
+
+
+def save_optim_method(method, path: str, overwrite: bool = False):
+    File.save(_dumps(method), path, overwrite)
+
+
+def load_optim_method(path: str):
+    return pickle.loads(File.load(path))
+
+
+def save_state_dict(state: Dict[str, Any], path: str, overwrite: bool = False):
+    import os
+
+    if not overwrite and os.path.exists(path):
+        raise FileExistsError(path)
+    np.savez(path, **{k: np.asarray(v) for k, v in state.items()})
+
+
+def load_state_dict_file(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

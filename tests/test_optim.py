@@ -1,0 +1,167 @@
+"""Optim-method oracle tests vs torch.optim, schedule math, triggers,
+validation methods."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import bigdl_tpu.optim as optim
+from bigdl_tpu.optim.optim_method import (
+    Adam, Adadelta, Adagrad, Adamax, Default, Exponential, LBFGS, MultiStep,
+    Plateau, Poly, RMSprop, SequentialSchedule, SGD, Step, Warmup,
+)
+
+
+def _run_method(method, torch_cls, torch_kwargs, steps=5, shape=(7,)):
+    """Run ours and torch's on the same quadratic problem; compare params."""
+    w0 = np.random.randn(*shape).astype(np.float32)
+    target = np.random.randn(*shape).astype(np.float32)
+
+    params = {"w": jnp.asarray(w0)}
+    state = method.init_state(params)
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch_cls([tw], **torch_kwargs)
+    tt = torch.tensor(target)
+
+    for _ in range(steps):
+        grads = {"w": 2.0 * (params["w"] - jnp.asarray(target))}
+        params, state = method.update(grads, params, state)
+        topt.zero_grad()
+        ((tw - tt) ** 2).sum().backward()
+        topt.step()
+    np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_plain_matches_torch():
+    _run_method(SGD(learning_rate=0.1), torch.optim.SGD, {"lr": 0.1})
+
+
+def test_sgd_momentum_nesterov_weightdecay():
+    _run_method(SGD(learning_rate=0.05, momentum=0.9, nesterov=True, weight_decay=0.01),
+                torch.optim.SGD, {"lr": 0.05, "momentum": 0.9, "nesterov": True,
+                                  "weight_decay": 0.01})
+
+
+def test_adam_matches_torch():
+    _run_method(Adam(learning_rate=0.01), torch.optim.Adam, {"lr": 0.01}, steps=10)
+
+
+def test_adamax_matches_torch():
+    _run_method(Adamax(learning_rate=0.02), torch.optim.Adamax, {"lr": 0.02}, steps=10)
+
+
+def test_adagrad_matches_torch():
+    _run_method(Adagrad(learning_rate=0.05), torch.optim.Adagrad, {"lr": 0.05}, steps=10)
+
+
+def test_adadelta_matches_torch():
+    _run_method(Adadelta(decay_rate=0.9, epsilon=1e-6), torch.optim.Adadelta,
+                {"rho": 0.9, "eps": 1e-6, "lr": 1.0}, steps=10)
+
+
+def test_rmsprop_matches_torch():
+    _run_method(RMSprop(learning_rate=0.01, decay_rate=0.99), torch.optim.RMSprop,
+                {"lr": 0.01, "alpha": 0.99}, steps=10)
+
+
+def test_lbfgs_converges_quadratic():
+    target = jnp.asarray(np.random.randn(10).astype(np.float32))
+
+    def feval(x):
+        return jnp.sum((x - target) ** 2), 2.0 * (x - target)
+
+    x, losses = LBFGS(max_iter=30).optimize(feval, jnp.zeros(10))
+    assert losses[-1] < 1e-6
+    np.testing.assert_allclose(np.asarray(x), np.asarray(target), atol=1e-3)
+
+
+# ------------------------------ schedules ---------------------------------
+
+def _st(neval, epoch=1):
+    return {"neval": jnp.asarray(neval, jnp.int32), "epoch": jnp.asarray(epoch, jnp.int32)}
+
+
+def test_schedules_math():
+    assert float(Default(0.1).rate(1.0, _st(10))) == pytest.approx(1.0 / 2.0)
+    assert float(Poly(0.5, 100).rate(1.0, _st(75))) == pytest.approx(0.5)
+    assert float(Step(10, 0.5).rate(1.0, _st(25))) == pytest.approx(0.25)
+    assert float(MultiStep([10, 20], 0.1).rate(1.0, _st(15))) == pytest.approx(0.1)
+    assert float(Exponential(10, 0.5, staircase=True).rate(1.0, _st(25))) == pytest.approx(0.25)
+    w = Warmup(0.01, 10, Step(1000, 1.0))
+    assert float(w.rate(0.1, _st(5))) == pytest.approx(0.15)
+    assert float(w.rate(0.1, _st(50))) == pytest.approx(0.2)
+    seq = SequentialSchedule().add(Poly(1.0, 10), 10).add(Step(1000, 1.0), 10**9)
+    assert float(seq.rate(1.0, _st(5))) == pytest.approx(0.5)
+    assert float(seq.rate(1.0, _st(15))) == pytest.approx(1.0)
+
+
+def test_plateau_host_side():
+    p = Plateau(factor=0.5, patience=2, mode="min")
+    for v in [1.0, 0.9, 0.91, 0.92, 0.93]:
+        p.on_metric(v)
+    assert p.current_factor == pytest.approx(0.5)
+
+
+def test_schedule_in_jitted_sgd():
+    sgd = SGD(learning_rate=1.0, learning_rate_schedule=Step(2, 0.5))
+    params = {"w": jnp.ones(3)}
+    state = sgd.init_state(params)
+
+    @jax.jit
+    def step(p, s):
+        return sgd.update({"w": jnp.ones(3)}, p, s)
+
+    lrs = []
+    for _ in range(5):
+        before = params["w"]
+        params, state = step(params, state)
+        lrs.append(float(before[0] - params["w"][0]))
+    assert lrs == pytest.approx([1.0, 1.0, 0.5, 0.5, 0.25])
+
+
+# ------------------------------ triggers ----------------------------------
+
+def test_triggers():
+    from bigdl_tpu.optim.trigger import Trigger
+
+    t = Trigger.several_iteration(3)
+    fires = [t({"neval": i}) for i in range(1, 10)]
+    assert fires == [False, False, True, False, False, True, False, False, True]
+    assert Trigger.max_epoch(5)({"epoch": 6})
+    assert not Trigger.max_epoch(5)({"epoch": 5})
+    assert Trigger.min_loss(0.1)({"loss": 0.05})
+    assert Trigger.max_score(0.9)({"score": 0.95})
+    e = Trigger.every_epoch()
+    assert not e({"epoch": 1, "_epoch_boundary": False})
+    assert e({"epoch": 2, "_epoch_boundary": True})
+    assert not e({"epoch": 2, "_epoch_boundary": True})  # once per epoch
+
+
+# ------------------------------ validation --------------------------------
+
+def test_validation_methods():
+    out = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1], [0.2, 0.3, 0.5], [0.9, 0.05, 0.05]])
+    target = np.array([1, 0, 0, 0])
+    r = optim.Top1Accuracy()(out, target)
+    assert r.result()[0] == pytest.approx(0.75)
+    r5 = optim.Top5Accuracy()(out, target)
+    assert r5.result()[0] == pytest.approx(1.0)
+    merged = r + r
+    assert merged.result() == (0.75, 8)
+    mae = optim.MAE()(out, np.array([1.0, 0.0, 2.0, 0.0]))
+    assert mae.result()[0] == pytest.approx(0.0)
+
+
+def test_regularizers():
+    from bigdl_tpu.optim.regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer
+
+    p = jnp.asarray([1.0, -2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(L2Regularizer(0.1).grad(p)), 0.1 * np.asarray(p))
+    np.testing.assert_allclose(np.asarray(L1Regularizer(0.1).grad(p)),
+                               0.1 * np.sign(np.asarray(p)))
+    assert float(L1L2Regularizer(0.1, 0.2).loss(p)) == pytest.approx(
+        0.1 * 6.0 + 0.5 * 0.2 * 14.0)

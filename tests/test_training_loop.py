@@ -1,0 +1,227 @@
+"""End-to-end training-loop tests on the virtual 8-device CPU mesh — the
+analogue of the reference's DistriOptimizerSpec strategy (SURVEY §4):
+distributed path exercised locally, correctness vs a naive reference
+optimizer (RefDistriOptimizer/RefLocalOptimizer), fault-injection for the
+retry path (ExceptionTest)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.nn.module import Module, functional_call, state_dict
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.parallel.mesh import make_mesh
+from bigdl_tpu.parallel.train_step import TrainStep, bf16_truncate
+
+
+def _make_data(n=64, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim,)).astype(np.float32)
+    y = (x @ w > 0).astype(np.int64)
+    return [Sample(x[i], np.int64(y[i])) for i in range(n)], x, y
+
+
+def _mlp(dim=4, seed=42):
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(seed)
+    return nn.Sequential(nn.Linear(dim, 16), nn.Tanh(), nn.Linear(16, 2), nn.LogSoftMax())
+
+
+def test_local_optimizer_trains():
+    samples, x, y = _make_data()
+    model = _mlp()
+    o = optim.LocalOptimizer(model, samples, nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_epoch(8))
+    o.set_optim_method(optim.SGD(learning_rate=0.5))
+    trained = o.optimize()
+    res = optim.Evaluator(trained).evaluate(samples, [optim.Top1Accuracy()])
+    acc = res[0][0].result()[0]
+    assert acc > 0.9, acc
+
+
+def test_distri_optimizer_on_8dev_mesh_matches_local():
+    """RefDistriOptimizer-style equivalence: mesh-sharded training must
+    follow the same trajectory as single-device training."""
+    samples, x, y = _make_data()
+    crit = nn.ClassNLLCriterion()
+    mesh = make_mesh()
+
+    m1 = _mlp(seed=7)
+    o1 = optim.DistriOptimizer(m1, samples, crit, batch_size=16,
+                               end_trigger=Trigger.max_iteration(12), mesh=mesh)
+    o1.set_optim_method(optim.SGD(learning_rate=0.5))
+    o1.optimize()
+
+    from bigdl_tpu.utils.rng import RNG
+
+    m2 = _mlp(seed=7)
+    o2 = optim.LocalOptimizer(m2, samples, crit, batch_size=16,
+                              end_trigger=Trigger.max_iteration(12))
+    o2.set_optim_method(optim.SGD(learning_rate=0.5))
+    o2.optimize()
+
+    p1, p2 = state_dict(m1), state_dict(m2)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_zero1_sharded_matches_allreduce():
+    """Sharded-optimizer (ZeRO-1) layout must be numerically equivalent to
+    plain allreduce (the reference's RefDistriOptimizer check for its
+    owner-node sharded update)."""
+    samples, _, _ = _make_data(n=64, dim=8)
+    crit = nn.ClassNLLCriterion()
+    mesh = make_mesh()
+    results = {}
+    for mode in ("allreduce", "sharded"):
+        m = _mlp(dim=8, seed=3)
+        o = optim.DistriOptimizer(m, samples, crit, batch_size=32,
+                                  end_trigger=Trigger.max_iteration(8), mesh=mesh)
+        o.set_optim_method(optim.Adam(learning_rate=0.05))
+        o.set_parameter_sync(mode)
+        o.optimize()
+        results[mode] = state_dict(m)
+    for k in results["allreduce"]:
+        np.testing.assert_allclose(np.asarray(results["allreduce"][k]),
+                                   np.asarray(results["sharded"][k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_truncation_exact_semantics():
+    x = jnp.asarray(np.random.randn(100).astype(np.float32))
+    t = np.asarray(bf16_truncate(x))
+    bits = t.view(np.uint32)
+    assert (bits & 0x0000FFFF).max() == 0  # low 16 bits cleared
+    assert np.abs(t - np.asarray(x)).max() < 0.01 * np.abs(np.asarray(x)).max() + 1e-6
+
+
+def test_bf16_compressed_training_still_converges():
+    samples, _, _ = _make_data()
+    m = _mlp(seed=5)
+    o = optim.LocalOptimizer(m, samples, nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_epoch(8))
+    o.set_optim_method(optim.SGD(learning_rate=0.5))
+    o.set_gradient_compression("bf16")
+    o.optimize()
+    res = optim.Evaluator(m).evaluate(samples, [optim.Top1Accuracy()])
+    assert res[0][0].result()[0] > 0.9
+
+
+def test_regularizer_and_freeze_in_train_step():
+    model = nn.Sequential(
+        nn.Linear(4, 8, w_regularizer=optim.L2Regularizer(0.1)), nn.Tanh(),
+        nn.Linear(8, 2))
+    model.get(2).freeze()
+    frozen_before = np.asarray(model.get(2).weight).copy()
+    step = TrainStep(model, nn.MSECriterion(), optim.SGD(learning_rate=0.1))
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randn(8, 2).astype(np.float32)
+    for i in range(3):
+        step.run(x, y, jax.random.key(i))
+    step.sync_to_model()
+    np.testing.assert_array_equal(np.asarray(model.get(2).weight), frozen_before)
+    assert not np.allclose(np.asarray(model.get(0).weight), 0)
+
+
+class ExceptionLayer(Module):
+    """Fault injection (``utils/TestUtils.scala:103`` ExceptionTest): throws
+    on the Nth forward."""
+
+    count = 0
+
+    def __init__(self, fail_at: int):
+        super().__init__()
+        self.fail_at = fail_at
+
+    def update_output(self, input):
+        ExceptionLayer.count += 1
+        if ExceptionLayer.count == self.fail_at:
+            raise RuntimeError("injected failure")
+        return input
+
+
+def test_retry_recovers_from_checkpoint(tmp_path):
+    samples, _, _ = _make_data(n=32)
+    ExceptionLayer.count = 0
+    model = nn.Sequential(nn.Linear(4, 8), ExceptionLayer(fail_at=6), nn.Tanh(),
+                          nn.Linear(8, 2), nn.LogSoftMax())
+    o = optim.LocalOptimizer(model, samples, nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_iteration(8))
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    o.set_checkpoint(str(tmp_path), Trigger.several_iteration(2)).overwrite_checkpoint()
+    trained = o.optimize()
+    assert o.state["neval"] >= 8  # completed despite the injected failure
+    assert os.path.exists(str(tmp_path))
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    samples, _, _ = _make_data()
+    m = _mlp(seed=11)
+    o = optim.LocalOptimizer(m, samples, nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_iteration(4))
+    o.set_optim_method(optim.Adam(learning_rate=0.01))
+    o.set_checkpoint(str(tmp_path), Trigger.several_iteration(2)).overwrite_checkpoint()
+    o.optimize()
+    from bigdl_tpu.utils.serializer import load_module, load_optim_method
+
+    mfile = optim.Optimizer.get_latest_file(str(tmp_path), "model")
+    ofile = optim.Optimizer.get_latest_file(str(tmp_path), "optimMethod")
+    assert mfile and mfile.endswith("model.4")
+    m2 = load_module(mfile)
+    om2 = load_optim_method(ofile)
+    p1, p2 = state_dict(m), state_dict(m2)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-6)
+    assert om2.state["driver_state"]["neval"] == 4
+    # resume continues the iteration count
+    o2 = optim.LocalOptimizer(m2, samples, nn.ClassNLLCriterion(), batch_size=16,
+                              end_trigger=Trigger.max_iteration(6))
+    o2.set_optim_method(om2)
+    o2.set_state(om2.state["driver_state"])
+    o2.optimize()
+    assert o2.state["neval"] == 6
+
+
+def test_validation_and_summary_hooks():
+    samples, _, _ = _make_data()
+    m = _mlp(seed=13)
+
+    class FakeSummary:
+        def __init__(self):
+            self.tags = []
+
+        def add_scalar(self, tag, value, step):
+            self.tags.append(tag)
+
+    ts, vs = FakeSummary(), FakeSummary()
+    o = optim.LocalOptimizer(m, samples, nn.ClassNLLCriterion(), batch_size=32,
+                             end_trigger=Trigger.max_iteration(4))
+    o.set_optim_method(optim.SGD(learning_rate=0.5))
+    o.set_validation(Trigger.several_iteration(2), samples,
+                     [optim.Top1Accuracy(), optim.Loss(nn.ClassNLLCriterion())], 32)
+    o.set_train_summary(ts).set_validation_summary(vs)
+    o.optimize()
+    assert "Loss" in ts.tags and "Throughput" in ts.tags and "LearningRate" in ts.tags
+    assert "Top1Accuracy" in vs.tags and "Loss" in vs.tags
+    assert "score" in o.state
+
+
+def test_predictor_and_evaluator():
+    samples, x, y = _make_data()
+    m = _mlp()
+    optim.LocalOptimizer(m, samples, nn.ClassNLLCriterion(), batch_size=16,
+                         end_trigger=Trigger.max_epoch(6)
+                         ).set_optim_method(optim.SGD(learning_rate=0.5)).optimize()
+    pred = optim.LocalPredictor(m).predict_class(samples)
+    assert (pred == y).mean() > 0.9
+    out = optim.LocalPredictor(m).predict(x)
+    assert out.shape == (64, 2)
