@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Benchmark driver: Inception-v1 synthetic-ImageNet training throughput on
+the local accelerator — the reference's benchmark protocol
+(``models/utils/DistriOptimizerPerf.scala:33-124`` / LocalOptimizerPerf:
+synthetic data, fixed batch, records/sec after warmup) on the north-star
+model from BASELINE.json.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numeric baseline (BASELINE.json "published": {}),
+so vs_baseline is reported against the reference's qualitative claim anchor:
+null.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    from bigdl_tpu import models
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.parallel.train_step import TrainStep
+
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(0)
+    model = models.build_inception_v1(1000)
+    crit = nn.ClassNLLCriterion()
+    step = TrainStep(model, crit, optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 3, 224, 224)).astype(np.float32)
+    y = rng.integers(0, 1000, batch)
+
+    for i in range(warmup):
+        loss = step.run(x, y, jax.random.key(i))
+    jax.block_until_ready(step.params)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        loss = step.run(x, y, jax.random.key(100 + i))
+    jax.block_until_ready(step.params)
+    wall = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / wall
+    print(json.dumps({
+        "metric": "inception_v1_imagenet_train_throughput",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

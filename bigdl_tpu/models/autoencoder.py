@@ -1,0 +1,19 @@
+"""Autoencoder / MNIST (``models/autoencoder/Autoencoder.scala``)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["build_autoencoder"]
+
+
+def build_autoencoder(class_num: int = 32) -> nn.Module:
+    """784 -> classNum -> 784 with sigmoid output (``Autoencoder.scala``)."""
+    row_n, col_n = 28, 28
+    return nn.Sequential(
+        nn.Reshape((row_n * col_n,)),
+        nn.Linear(row_n * col_n, class_num),
+        nn.ReLU(True),
+        nn.Linear(class_num, row_n * col_n),
+        nn.Sigmoid(),
+    )

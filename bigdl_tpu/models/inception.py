@@ -1,0 +1,188 @@
+"""Inception v1 / v2 (``models/inception/Inception_v1.scala``,
+``Inception_v2.scala``) — the reference's flagship benchmark model
+(``models/utils/DistriOptimizerPerf.scala``).
+
+Built with the Concat container exactly like the reference's
+``inception`` helper; v1 includes the two auxiliary classifier heads used
+during training (``Inception_v1.scala`` aux1/aux2) behind
+``with_aux=True``."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["inception_layer_v1", "build_inception_v1", "build_inception_v2"]
+
+
+def inception_layer_v1(input_size: int, config, name_prefix: str = "") -> nn.Module:
+    """One inception module: 1x1 / 3x3reduce+3x3 / 5x5reduce+5x5 / pool+proj
+    branches concatenated on the channel dim (``Inception_v1.scala``
+    ``inception`` fn)."""
+    concat = nn.Concat(1).set_name(name_prefix + "inception")
+    conv1 = nn.Sequential(
+        nn.SpatialConvolution(input_size, config[0][0], 1, 1, 1, 1)
+        .set_name(name_prefix + "1x1"),
+        nn.ReLU(True))
+    concat.add(conv1)
+    conv3 = nn.Sequential(
+        nn.SpatialConvolution(input_size, config[1][0], 1, 1, 1, 1)
+        .set_name(name_prefix + "3x3_reduce"),
+        nn.ReLU(True),
+        nn.SpatialConvolution(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1)
+        .set_name(name_prefix + "3x3"),
+        nn.ReLU(True))
+    concat.add(conv3)
+    conv5 = nn.Sequential(
+        nn.SpatialConvolution(input_size, config[2][0], 1, 1, 1, 1)
+        .set_name(name_prefix + "5x5_reduce"),
+        nn.ReLU(True),
+        nn.SpatialConvolution(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2)
+        .set_name(name_prefix + "5x5"),
+        nn.ReLU(True))
+    concat.add(conv5)
+    pool = nn.Sequential(
+        nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
+        nn.SpatialConvolution(input_size, config[3][0], 1, 1, 1, 1)
+        .set_name(name_prefix + "pool_proj"),
+        nn.ReLU(True))
+    concat.add(pool)
+    return concat
+
+
+def build_inception_v1(class_num: int = 1000, has_dropout: bool = True,
+                       with_aux: bool = False) -> nn.Module:
+    """GoogLeNet (``Inception_v1.scala`` inception_v1_NoAuxClassifier /
+    inception_v1)."""
+    feature1 = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3).set_name("conv1/7x7_s2"),
+        nn.ReLU(True),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"),
+        nn.SpatialConvolution(64, 64, 1, 1, 1, 1).set_name("conv2/3x3_reduce"),
+        nn.ReLU(True),
+        nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1).set_name("conv2/3x3"),
+        nn.ReLU(True),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"),
+        inception_layer_v1(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v1(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"),
+    )
+    feature2 = nn.Sequential(
+        inception_layer_v1(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"),
+        inception_layer_v1(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"),
+        inception_layer_v1(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"),
+    )
+    feature3 = nn.Sequential(
+        inception_layer_v1(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v1(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"),
+        inception_layer_v1(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"),
+    )
+    head = nn.Sequential(
+        nn.SpatialAveragePooling(7, 7, 1, 1),
+        nn.View(1024).set_num_input_dims(3),
+    )
+    if has_dropout:
+        head.add(nn.Dropout(0.4))
+    head.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    head.add(nn.LogSoftMax().set_name("loss3/loss3"))
+
+    if not with_aux:
+        return nn.Sequential(feature1, feature2, feature3, head)
+
+    def aux_head(in_ch: int, name: str) -> nn.Module:
+        return nn.Sequential(
+            nn.SpatialAveragePooling(5, 5, 3, 3).ceil(),
+            nn.SpatialConvolution(in_ch, 128, 1, 1, 1, 1).set_name(name + "/conv"),
+            nn.ReLU(True),
+            nn.View(128 * 4 * 4).set_num_input_dims(3),
+            nn.Linear(128 * 4 * 4, 1024).set_name(name + "/fc"),
+            nn.ReLU(True),
+            nn.Dropout(0.7),
+            nn.Linear(1024, class_num).set_name(name + "/classifier"),
+            nn.LogSoftMax(),
+        )
+
+    # training graph with aux classifiers: outputs (main, aux1, aux2)
+    split1 = nn.ConcatTable().add(nn.Sequential(feature2,
+                                                nn.ConcatTable().add(nn.Sequential(feature3, head))
+                                                .add(aux_head(528, "loss2"))))\
+                             .add(aux_head(512, "loss1"))
+    model = nn.Sequential(feature1, split1, nn.FlattenTable())
+    return model
+
+
+def _conv_bn(input_size, output_size, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    return [nn.SpatialConvolution(input_size, output_size, kw, kh, sw, sh, pw, ph)
+            .set_name(name), nn.SpatialBatchNormalization(output_size, 1e-3), nn.ReLU(True)]
+
+
+def inception_layer_v2(input_size: int, config, name_prefix: str = "") -> nn.Module:
+    """Inception-BN module (``Inception_v2.scala`` inception): 3x3 double
+    branch, avg/max pool selectable, optional stride-2 pass-through."""
+    concat = nn.Concat(1)
+    if config[0][0] != 0:
+        b1 = nn.Sequential()
+        for l in _conv_bn(input_size, config[0][0], 1, 1, name=name_prefix + "1x1"):
+            b1.add(l)
+        concat.add(b1)
+    b3 = nn.Sequential()
+    for l in _conv_bn(input_size, config[1][0], 1, 1, name=name_prefix + "3x3_reduce"):
+        b3.add(l)
+    stride = 2 if config[0][0] == 0 else 1
+    for l in _conv_bn(config[1][0], config[1][1], 3, 3, stride, stride, 1, 1,
+                      name=name_prefix + "3x3"):
+        b3.add(l)
+    concat.add(b3)
+    bd = nn.Sequential()
+    for l in _conv_bn(input_size, config[2][0], 1, 1, name=name_prefix + "double3x3_reduce"):
+        bd.add(l)
+    for l in _conv_bn(config[2][0], config[2][1], 3, 3, 1, 1, 1, 1,
+                      name=name_prefix + "double3x3a"):
+        bd.add(l)
+    for l in _conv_bn(config[2][1], config[2][1], 3, 3, stride, stride, 1, 1,
+                      name=name_prefix + "double3x3b"):
+        bd.add(l)
+    concat.add(bd)
+    pool = nn.Sequential()
+    pool_pad = 1 if stride == 1 else 0  # stride-2 downsampling pools are unpadded
+    if config[3][0] == "max":
+        pool.add(nn.SpatialMaxPooling(3, 3, stride, stride, pool_pad, pool_pad).ceil())
+    else:
+        pool.add(nn.SpatialAveragePooling(3, 3, stride, stride, pool_pad, pool_pad,
+                                          ceil_mode=True))
+    if config[3][1] != 0:
+        for l in _conv_bn(input_size, config[3][1], 1, 1, name=name_prefix + "pool_proj"):
+            pool.add(l)
+    concat.add(pool)
+    return concat
+
+
+def build_inception_v2(class_num: int = 1000) -> nn.Module:
+    """(``Inception_v2.scala``)."""
+    m = nn.Sequential()
+    for l in _conv_bn(3, 64, 7, 7, 2, 2, 3, 3, "conv1/7x7_s2"):
+        m.add(l)
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for l in _conv_bn(64, 64, 1, 1, name="conv2/3x3_reduce"):
+        m.add(l)
+    for l in _conv_bn(64, 192, 3, 3, 1, 1, 1, 1, "conv2/3x3"):
+        m.add(l)
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(inception_layer_v2(192, [[64], [64, 64], [64, 96], ["avg", 32]], "inception_3a/"))
+    m.add(inception_layer_v2(256, [[64], [64, 96], [64, 96], ["avg", 64]], "inception_3b/"))
+    m.add(inception_layer_v2(320, [[0], [128, 160], [64, 96], ["max", 0]], "inception_3c/"))
+    m.add(inception_layer_v2(576, [[224], [64, 96], [96, 128], ["avg", 128]], "inception_4a/"))
+    m.add(inception_layer_v2(576, [[192], [96, 128], [96, 128], ["avg", 128]], "inception_4b/"))
+    m.add(inception_layer_v2(576, [[160], [128, 160], [128, 160], ["avg", 96]], "inception_4c/"))
+    m.add(inception_layer_v2(576, [[96], [128, 192], [160, 192], ["avg", 96]], "inception_4d/"))
+    m.add(inception_layer_v2(576, [[0], [128, 192], [192, 256], ["max", 0]], "inception_4e/"))
+    m.add(inception_layer_v2(1024, [[352], [192, 320], [160, 224], ["avg", 128]], "inception_5a/"))
+    m.add(inception_layer_v2(1024, [[352], [192, 320], [192, 224], ["max", 128]], "inception_5b/"))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.View(1024).set_num_input_dims(3))
+    m.add(nn.Linear(1024, class_num))
+    m.add(nn.LogSoftMax())
+    return m

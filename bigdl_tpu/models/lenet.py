@@ -1,0 +1,24 @@
+"""LeNet-5 (``models/lenet/LeNet5.scala``)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["build_lenet5"]
+
+
+def build_lenet5(class_num: int = 10) -> nn.Module:
+    return nn.Sequential(
+        nn.Reshape((1, 28, 28)),
+        nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape((12 * 4 * 4,)),
+        nn.Linear(12 * 4 * 4, 100).set_name("fc1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num).set_name("fc2"),
+        nn.LogSoftMax(),
+    )

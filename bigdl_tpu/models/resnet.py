@@ -1,0 +1,127 @@
+"""ResNet (``models/resnet/ResNet.scala``): CIFAR-10 (depth 20/32/.../110,
+basic blocks) and ImageNet (ResNet-18/34/50/101/152, basic or bottleneck)
+variants with shortcut types A (zero-pad identity), B (1x1 conv on
+dimension change), C (1x1 conv always)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module
+
+__all__ = ["build_resnet", "build_resnet_cifar", "basic_block", "bottleneck"]
+
+
+class _ZeroPadShortcut(Module):
+    """Shortcut type A: stride then zero-pad channels (ResNet.scala
+    shortcut 'A')."""
+
+    def __init__(self, n_in: int, n_out: int, stride: int):
+        super().__init__()
+        self.n_in, self.n_out, self.stride = n_in, n_out, stride
+
+    def update_output(self, input):
+        x = input[:, :, ::self.stride, ::self.stride]
+        pad = self.n_out - self.n_in
+        if pad > 0:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return x
+
+
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str) -> Module:
+    use_conv = shortcut_type == "C" or (shortcut_type == "B" and (n_in != n_out or stride != 1))
+    if use_conv:
+        return nn.Sequential(
+            nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride),
+            nn.SpatialBatchNormalization(n_out))
+    if n_in != n_out or stride != 1:
+        return _ZeroPadShortcut(n_in, n_out, stride)
+    return nn.Identity()
+
+
+def basic_block(n_in: int, n: int, stride: int, shortcut_type: str = "B") -> Module:
+    s = nn.Sequential(
+        nn.SpatialConvolution(n_in, n, 3, 3, stride, stride, 1, 1),
+        nn.SpatialBatchNormalization(n),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n, n, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n))
+    return nn.Sequential(
+        nn.ConcatTable().add(s).add(_shortcut(n_in, n, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True))
+
+
+def bottleneck(n_in: int, n: int, stride: int, shortcut_type: str = "B") -> Module:
+    n_out = n * 4
+    s = nn.Sequential(
+        nn.SpatialConvolution(n_in, n, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n, n, 3, 3, stride, stride, 1, 1),
+        nn.SpatialBatchNormalization(n),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n, n_out, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_out))
+    return nn.Sequential(
+        nn.ConcatTable().add(s).add(_shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True))
+
+
+_IMAGENET_CFGS = {
+    18: ([2, 2, 2, 2], basic_block, 512),
+    34: ([3, 4, 6, 3], basic_block, 512),
+    50: ([3, 4, 6, 3], bottleneck, 2048),
+    101: ([3, 4, 23, 3], bottleneck, 2048),
+    152: ([3, 8, 36, 3], bottleneck, 2048),
+}
+
+
+def build_resnet(depth: int = 50, class_num: int = 1000,
+                 shortcut_type: str = "B") -> nn.Module:
+    """ImageNet ResNet (``ResNet.scala`` apply, dataset=ImageNet)."""
+    counts, block, n_features = _IMAGENET_CFGS[depth]
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False),
+        nn.SpatialBatchNormalization(64),
+        nn.ReLU(True),
+        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+    n_in = 64
+    widths = [64, 128, 256, 512]
+    for stage, (w, count) in enumerate(zip(widths, counts)):
+        for i in range(count):
+            stride = 2 if stage > 0 and i == 0 else 1
+            m.add(block(n_in, w, stride, shortcut_type))
+            n_in = w * 4 if block is bottleneck else w
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.View(n_features).set_num_input_dims(3))
+    m.add(nn.Linear(n_features, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def build_resnet_cifar(depth: int = 20, class_num: int = 10,
+                       shortcut_type: str = "A") -> nn.Module:
+    """CIFAR-10 ResNet (``ResNet.scala`` apply, dataset=CIFAR-10):
+    depth = 6n+2 basic blocks."""
+    assert (depth - 2) % 6 == 0, "CIFAR depth must be 6n+2"
+    n = (depth - 2) // 6
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(16),
+        nn.ReLU(True))
+    n_in = 16
+    for stage, w in enumerate([16, 32, 64]):
+        for i in range(n):
+            stride = 2 if stage > 0 and i == 0 else 1
+            m.add(basic_block(n_in, w, stride, shortcut_type))
+            n_in = w
+    m.add(nn.SpatialAveragePooling(8, 8, 1, 1))
+    m.add(nn.View(64).set_num_input_dims(3))
+    m.add(nn.Linear(64, class_num))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,33 @@
+"""SimpleRNN text model (``models/rnn/SimpleRNN.scala``) and an LSTM text
+classifier (the reference's LSTM-text-classification benchmark config,
+BASELINE.md config 4)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["build_simple_rnn", "build_lstm_classifier"]
+
+
+def build_simple_rnn(input_size: int = 4000, hidden_size: int = 40,
+                     output_size: int = 4000) -> nn.Module:
+    """(``SimpleRNN.scala``): one-hot input -> RnnCell over time ->
+    TimeDistributed Linear + LogSoftMax (per-timestep prediction)."""
+    return nn.Sequential(
+        nn.Recurrent(nn.RnnCell(input_size, hidden_size, nn.Tanh())),
+        nn.TimeDistributed(nn.Sequential(nn.Linear(hidden_size, output_size),
+                                         nn.LogSoftMax())),
+    )
+
+
+def build_lstm_classifier(vocab_size: int, embed_dim: int = 128,
+                          hidden_size: int = 128, class_num: int = 2,
+                          one_based_tokens: bool = False) -> nn.Module:
+    """LSTM text classification: embedding -> LSTM -> last step -> dense."""
+    return nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim, one_based=one_based_tokens),
+        nn.Recurrent(nn.LSTM(embed_dim, hidden_size)),
+        nn.Select(1, -1),
+        nn.Linear(hidden_size, class_num),
+        nn.LogSoftMax(),
+    )

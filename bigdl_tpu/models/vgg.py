@@ -1,0 +1,74 @@
+"""VGG (``models/vgg/VggForCifar10.scala`` and the 16/19-layer ImageNet
+configs used by the reference perf harness,
+``models/utils/DistriOptimizerPerf.scala``)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["build_vgg_for_cifar10", "build_vgg16", "build_vgg19"]
+
+
+def _conv_bn_relu(model: nn.Sequential, n_in: int, n_out: int):
+    model.add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+    model.add(nn.SpatialBatchNormalization(n_out, 1e-3))
+    model.add(nn.ReLU(True))
+
+
+def build_vgg_for_cifar10(class_num: int = 10, has_dropout: bool = True) -> nn.Module:
+    """(``VggForCifar10.scala``)."""
+    m = nn.Sequential()
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    for item in cfg:
+        if item == "M":
+            m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            _conv_bn_relu(m, *item)
+    m.add(nn.View(512))
+    classifier = nn.Sequential()
+    if has_dropout:
+        classifier.add(nn.Dropout(0.5))
+    classifier.add(nn.Linear(512, 512))
+    classifier.add(nn.BatchNormalization(512))
+    classifier.add(nn.ReLU(True))
+    if has_dropout:
+        classifier.add(nn.Dropout(0.5))
+    classifier.add(nn.Linear(512, class_num))
+    classifier.add(nn.LogSoftMax())
+    m.add(classifier)
+    return m
+
+
+def _vgg_imagenet(cfg, class_num: int) -> nn.Module:
+    m = nn.Sequential()
+    n_in = 3
+    for item in cfg:
+        if item == "M":
+            m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            m.add(nn.SpatialConvolution(n_in, item, 3, 3, 1, 1, 1, 1))
+            m.add(nn.ReLU(True))
+            n_in = item
+    m.add(nn.View(512 * 7 * 7))
+    m.add(nn.Linear(512 * 7 * 7, 4096))
+    m.add(nn.Threshold(0, 1e-6))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096))
+    m.add(nn.Threshold(0, 1e-6))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def build_vgg16(class_num: int = 1000) -> nn.Module:
+    return _vgg_imagenet([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                          512, 512, 512, "M", 512, 512, 512, "M"], class_num)
+
+
+def build_vgg19(class_num: int = 1000) -> nn.Module:
+    return _vgg_imagenet([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"], class_num)

@@ -112,8 +112,7 @@ class SpatialConvolution(_ConvBase):
         pad_w = _pair_padding(self.pad_w, self.kernel_w, self.stride_w, x.shape[w_ax])
         y = lax.conv_general_dilated(
             x, w.astype(x.dtype), (self.stride_h, self.stride_w), (pad_h, pad_w),
-            dimension_numbers=dn, feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            dimension_numbers=dn, feature_group_count=self.n_group)
         if self.with_bias:
             bshape = [1, 1, 1, 1]
             bshape[c_ax] = self.n_output_plane
@@ -157,8 +156,7 @@ class SpatialDilatedConvolution(_ConvBase):
         pad_w = _pair_padding(self.pad_w, eff_kw, self.dw, x.shape[3])
         y = lax.conv_general_dilated(
             x, self.weight.astype(x.dtype), (self.dh, self.dw), (pad_h, pad_w),
-            rhs_dilation=(self.dilation_h, self.dilation_w), dimension_numbers=dn,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            rhs_dilation=(self.dilation_h, self.dilation_w), dimension_numbers=dn)
         y = y + self.bias.reshape(1, -1, 1, 1).astype(y.dtype)
         return y[0] if squeeze else y
 
@@ -209,8 +207,7 @@ class SpatialFullConvolution(_ConvBase):
         y = lax.conv_general_dilated(
             x, w.astype(x.dtype), (1, 1), (pad_h, pad_w),
             lhs_dilation=(self.dh, self.dw), dimension_numbers=dn,
-            feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            feature_group_count=self.n_group)
         if self.with_bias:
             y = y + self.bias.reshape(1, -1, 1, 1).astype(y.dtype)
         return y[0] if squeeze else y
@@ -254,7 +251,7 @@ class SpatialConvolutionMap(Module):
         y = lax.conv_general_dilated(
             x, w.astype(x.dtype), (self.dh, self.dw),
             ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            dimension_numbers=dn, preferred_element_type=jnp.float32).astype(x.dtype)
+            dimension_numbers=dn)
         y = y + self.bias.reshape(1, -1, 1, 1).astype(y.dtype)
         return y[0] if squeeze else y
 
@@ -285,8 +282,7 @@ class TemporalConvolution(_ConvBase):
         dn = lax.conv_dimension_numbers(x.shape, (self.kernel_w, 1, 1), ("NWC", "WIO", "NWC"))
         w = jnp.transpose(self.weight, (2, 1, 0))  # OIW -> WIO
         y = lax.conv_general_dilated(
-            x, w.astype(x.dtype), (self.stride_w,), ((0, 0),), dimension_numbers=dn,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            x, w.astype(x.dtype), (self.stride_w,), ((0, 0),), dimension_numbers=dn)
         y = y + self.bias.astype(y.dtype)
         return y[0] if squeeze else y
 
@@ -321,7 +317,7 @@ class VolumetricConvolution(_ConvBase):
                 _pair_padding(self.pad_w, self.k_w, self.d_w, x.shape[4])]
         y = lax.conv_general_dilated(
             x, self.weight.astype(x.dtype), (self.d_t, self.d_h, self.d_w), pads,
-            dimension_numbers=dn, preferred_element_type=jnp.float32).astype(x.dtype)
+            dimension_numbers=dn)
         if self.with_bias:
             y = y + self.bias.reshape(1, -1, 1, 1, 1).astype(y.dtype)
         return y[0] if squeeze else y
@@ -374,8 +370,7 @@ class VolumetricFullConvolution(_ConvBase):
         y = lax.conv_general_dilated(
             x, w.astype(x.dtype), (1, 1, 1), pads,
             lhs_dilation=(self.d_t, self.d_h, self.d_w), dimension_numbers=dn,
-            feature_group_count=self.n_group,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            feature_group_count=self.n_group)
         if self.with_bias:
             y = y + self.bias.reshape(1, -1, 1, 1, 1).astype(y.dtype)
         return y[0] if squeeze else y

@@ -60,9 +60,13 @@ class Linear(Module):
     def update_output(self, input):
         squeeze = input.ndim == 1
         x = input[None, :] if squeeze else input
-        y = jnp.dot(x, self.weight.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        # Cast weights to the activation dtype (bf16 compute keeps bf16 out;
+        # the MXU still accumulates bf16 contractions in f32 internally).
+        # No preferred_element_type: the f32-preferred + downcast sandwich
+        # breaks the dot/conv transpose dtypes under mixed precision.
+        y = jnp.dot(x, self.weight.T.astype(x.dtype))
         if self.with_bias:
-            y = y + self.bias
+            y = y + self.bias.astype(y.dtype)
         return y[0] if squeeze else y
 
 

@@ -1,0 +1,106 @@
+"""Model-zoo shape/loss tests (the reference's ``models/`` specs,
+SURVEY §4 'models/ (7: model graphs produce expected shapes/loss)')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import models
+from bigdl_tpu.nn.module import functional_call, state_dict
+
+
+def _check_train_step(model, x_shape, n_classes, rtol_loss=0.6):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=x_shape).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, n_classes, x_shape[0]))
+    crit = nn.ClassNLLCriterion()
+    p = state_dict(model)
+
+    def loss_fn(p):
+        out, _ = functional_call(model, p, x, training=True,
+                                 rng=jax.random.key(0))
+        return crit.update_output(out, y)
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(p)
+    expected = np.log(n_classes)
+    assert abs(float(loss) - expected) < rtol_loss * expected, float(loss)
+    gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in grads.values())
+    assert gnorm > 0
+
+
+def test_lenet5():
+    m = models.build_lenet5(10)
+    out = m.forward(jnp.ones((2, 28 * 28)))
+    assert out.shape == (2, 10)
+    _check_train_step(m, (4, 1, 28, 28), 10)
+
+
+def test_vgg_cifar():
+    m = models.build_vgg_for_cifar10(10)
+    out = m.evaluate().forward(jnp.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_inception_v1():
+    m = models.build_inception_v1(1000)
+    out = m.evaluate().forward(jnp.ones((2, 3, 224, 224)))
+    assert out.shape == (2, 1000)
+
+
+def test_inception_v1_aux():
+    m = models.build_inception_v1(100, with_aux=True)
+    outs = m.evaluate().forward(jnp.ones((1, 3, 224, 224)))
+    assert isinstance(outs, list) and len(outs) == 3
+    for o in outs:
+        assert o.shape == (1, 100)
+
+
+def test_inception_v2():
+    m = models.build_inception_v2(1000)
+    out = m.evaluate().forward(jnp.ones((1, 3, 224, 224)))
+    assert out.shape == (1, 1000)
+
+
+@pytest.mark.parametrize("depth,block_out", [(18, 512), (50, 2048)])
+def test_resnet_imagenet(depth, block_out):
+    m = models.build_resnet(depth, 1000)
+    out = m.evaluate().forward(jnp.ones((1, 3, 224, 224)))
+    assert out.shape == (1, 1000)
+
+
+def test_resnet_cifar_shortcut_a():
+    m = models.build_resnet_cifar(20, 10, shortcut_type="A")
+    out = m.evaluate().forward(jnp.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+    _check_train_step(m.train(), (2, 3, 32, 32), 10)
+
+
+def test_simple_rnn_and_lstm_classifier():
+    m = models.build_simple_rnn(100, 16, 100)
+    out = m.forward(jnp.ones((2, 5, 100)))
+    assert out.shape == (2, 5, 100)
+    clf = models.build_lstm_classifier(vocab_size=50, embed_dim=8,
+                                       hidden_size=12, class_num=3)
+    tokens = jnp.asarray(np.random.randint(0, 50, (4, 7)))
+    out = clf.forward(tokens)
+    assert out.shape == (4, 3)
+
+
+def test_autoencoder_trains():
+    m = models.build_autoencoder(32)
+    x = jnp.asarray(np.random.rand(8, 784).astype(np.float32))
+    out = m.forward(x)
+    assert out.shape == (8, 784)
+    crit = nn.MSECriterion()
+    p = state_dict(m)
+
+    def loss_fn(p):
+        out, _ = functional_call(m, p, x)
+        return crit.update_output(out, x)
+
+    l0 = float(loss_fn(p))
+    g = jax.grad(loss_fn)(p)
+    p2 = {k: p[k] - 0.5 * g[k] for k in p}
+    assert float(loss_fn(p2)) < l0
