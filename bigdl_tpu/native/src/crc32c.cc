@@ -1,0 +1,57 @@
+// CRC32C (Castagnoli) — slice-by-8 software implementation, plus the
+// TFRecord "masked" variant. TPU-native counterpart of the reference's
+// netty/Crc32c.java (consumed by visualization/tensorboard/RecordWriter.scala:30).
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+constexpr uint32_t kPoly = 0x82F63B78u;  // reflected CRC32C polynomial
+
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc >> 1) ^ ((crc & 1) ? kPoly : 0);
+      t[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xFF];
+  }
+};
+
+const Tables kTables;
+
+}  // namespace
+
+extern "C" {
+
+uint32_t bigdl_crc32c(const uint8_t* data, size_t n, uint32_t crc_in) {
+  uint32_t crc = ~crc_in;
+  const uint32_t (*t)[256] = kTables.t;
+  while (n >= 8) {
+    crc ^= (uint32_t)data[0] | ((uint32_t)data[1] << 8) |
+           ((uint32_t)data[2] << 16) | ((uint32_t)data[3] << 24);
+    uint32_t hi = (uint32_t)data[4] | ((uint32_t)data[5] << 8) |
+                  ((uint32_t)data[6] << 16) | ((uint32_t)data[7] << 24);
+    crc = t[7][crc & 0xFF] ^ t[6][(crc >> 8) & 0xFF] ^
+          t[5][(crc >> 16) & 0xFF] ^ t[4][crc >> 24] ^
+          t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF] ^
+          t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = t[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// TFRecord masking: ((crc >> 15) | (crc << 17)) + 0xa282ead8
+uint32_t bigdl_masked_crc32c(const uint8_t* data, size_t n) {
+  uint32_t crc = bigdl_crc32c(data, n, 0);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+}  // extern "C"

@@ -1,0 +1,50 @@
+// Multi-threaded batch assembly — native counterpart of the reference's
+// MTLabeledBGRImgToBatch (dataset/image/MTLabeledBGRImgToBatch.scala):
+// crop + flip + channel-normalize a stack of uint8 HWC images into one
+// float32 NCHW batch, parallel over images with std::thread.
+#include <cstdint>
+#include <cstddef>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+extern "C" {
+
+// imgs: N contiguous uint8 images [H, W, C]; out: [N, C, ch, cw] float32.
+// crop offsets per image (oy[i], ox[i]); flip[i] != 0 => horizontal flip;
+// mean/std per channel (length C).
+void bigdl_batch_crop_normalize(const uint8_t* imgs, int n, int h, int w,
+                                int c, int ch, int cw, const int32_t* oy,
+                                const int32_t* ox, const uint8_t* flip,
+                                const float* mean, const float* stdd,
+                                float* out, int num_threads) {
+  if (num_threads <= 0)
+    num_threads = (int)std::thread::hardware_concurrency();
+  num_threads = std::max(1, std::min(num_threads, n));
+  auto work = [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const uint8_t* img = imgs + (size_t)i * h * w * c;
+      float* dst = out + (size_t)i * c * ch * cw;
+      for (int y = 0; y < ch; ++y) {
+        const int sy = oy[i] + y;
+        for (int x = 0; x < cw; ++x) {
+          const int sx = flip[i] ? (ox[i] + cw - 1 - x) : (ox[i] + x);
+          const uint8_t* px = img + ((size_t)sy * w + sx) * c;
+          for (int k = 0; k < c; ++k)
+            dst[((size_t)k * ch + y) * cw + x] =
+                ((float)px[k] - mean[k]) / stdd[k];
+        }
+      }
+    }
+  };
+  std::vector<std::thread> ts;
+  const int chunk = (n + num_threads - 1) / num_threads;
+  for (int t = 0; t < num_threads; ++t) {
+    const int lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // extern "C"

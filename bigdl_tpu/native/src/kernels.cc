@@ -1,0 +1,204 @@
+// CPU oracle kernels — the TPU-native counterpart of the reference's
+// BigDL-core native layer (mkl-java/bigdl-native JNI, SURVEY §2.1:
+// BLAS gemm/gemv/ger/axpy/dot/scal + VML Add/Sub/Mul/Div/Powx/Ln/Exp/
+// Sqrt/Tanh/Log1p/Abs, consumed at tensor/TensorNumeric.scala:457-530).
+// On TPU the hot path is XLA (MXU/VPU); these kernels are the host-side
+// numeric oracle used by the test suite and as a CPU fallback runtime.
+#include <cstdint>
+#include <cstddef>
+#include <cmath>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// ---------- BLAS (row-agnostic: column-major like Fortran/MKL) ----------
+// C[m,n] = alpha * op(A) @ op(B) + beta * C ; lda/ldb/ldc leading dims.
+void bigdl_sgemm(char transa, char transb, int m, int n, int k, float alpha,
+                 const float* A, int lda, const float* B, int ldb, float beta,
+                 float* C, int ldc) {
+  const bool ta = (transa == 'T' || transa == 't');
+  const bool tb = (transb == 'T' || transb == 't');
+  for (int j = 0; j < n; ++j) {
+    for (int i = 0; i < m; ++i) {
+      double acc = 0.0;
+      for (int p = 0; p < k; ++p) {
+        const float a = ta ? A[i * lda + p] : A[p * lda + i];
+        const float b = tb ? B[p * ldb + j] : B[j * ldb + p];
+        acc += (double)a * b;
+      }
+      C[j * ldc + i] = alpha * (float)acc + beta * C[j * ldc + i];
+    }
+  }
+}
+
+void bigdl_dgemm(char transa, char transb, int m, int n, int k, double alpha,
+                 const double* A, int lda, const double* B, int ldb,
+                 double beta, double* C, int ldc) {
+  const bool ta = (transa == 'T' || transa == 't');
+  const bool tb = (transb == 'T' || transb == 't');
+  for (int j = 0; j < n; ++j) {
+    for (int i = 0; i < m; ++i) {
+      double acc = 0.0;
+      for (int p = 0; p < k; ++p) {
+        const double a = ta ? A[i * lda + p] : A[p * lda + i];
+        const double b = tb ? B[p * ldb + j] : B[j * ldb + p];
+        acc += a * b;
+      }
+      C[j * ldc + i] = alpha * acc + beta * C[j * ldc + i];
+    }
+  }
+}
+
+void bigdl_sgemv(char trans, int m, int n, float alpha, const float* A,
+                 int lda, const float* x, int incx, float beta, float* y,
+                 int incy) {
+  const bool t = (trans == 'T' || trans == 't');
+  const int ylen = t ? n : m;
+  const int xlen = t ? m : n;
+  for (int i = 0; i < ylen; ++i) {
+    double acc = 0.0;
+    for (int j = 0; j < xlen; ++j) {
+      const float a = t ? A[i * lda + j] : A[j * lda + i];
+      acc += (double)a * x[j * incx];
+    }
+    y[i * incy] = alpha * (float)acc + beta * y[i * incy];
+  }
+}
+
+void bigdl_sger(int m, int n, float alpha, const float* x, int incx,
+                const float* y, int incy, float* A, int lda) {
+  for (int j = 0; j < n; ++j)
+    for (int i = 0; i < m; ++i)
+      A[j * lda + i] += alpha * x[i * incx] * y[j * incy];
+}
+
+void bigdl_saxpy(int n, float a, const float* x, int incx, float* y,
+                 int incy) {
+  for (int i = 0; i < n; ++i) y[i * incy] += a * x[i * incx];
+}
+
+float bigdl_sdot(int n, const float* x, int incx, const float* y, int incy) {
+  double acc = 0.0;
+  for (int i = 0; i < n; ++i) acc += (double)x[i * incx] * y[i * incy];
+  return (float)acc;
+}
+
+void bigdl_sscal(int n, float a, float* x, int incx) {
+  for (int i = 0; i < n; ++i) x[i * incx] *= a;
+}
+
+// ---------- VML elementwise (float32) ----------
+#define VML_BINOP(name, expr)                                            \
+  void bigdl_vs##name(int n, const float* a, const float* b, float* y) { \
+    for (int i = 0; i < n; ++i) y[i] = (expr);                           \
+  }
+VML_BINOP(Add, a[i] + b[i])
+VML_BINOP(Sub, a[i] - b[i])
+VML_BINOP(Mul, a[i] * b[i])
+VML_BINOP(Div, a[i] / b[i])
+#undef VML_BINOP
+
+#define VML_UNOP(name, expr)                                  \
+  void bigdl_vs##name(int n, const float* a, float* y) {      \
+    for (int i = 0; i < n; ++i) y[i] = (expr);                \
+  }
+VML_UNOP(Ln, std::log(a[i]))
+VML_UNOP(Exp, std::exp(a[i]))
+VML_UNOP(Sqrt, std::sqrt(a[i]))
+VML_UNOP(Tanh, std::tanh(a[i]))
+VML_UNOP(Log1p, std::log1p(a[i]))
+VML_UNOP(Abs, std::fabs(a[i]))
+#undef VML_UNOP
+
+void bigdl_vsPowx(int n, const float* a, float b, float* y) {
+  for (int i = 0; i < n; ++i) y[i] = std::pow(a[i], b);
+}
+
+// ---------- NN primitives (reference nn/NNPrimitive.scala hot loops) ----
+// im2col, NCHW. input [C,H,W] -> cols [C*kh*kw, outH*outW]
+void bigdl_im2col(const float* img, int channels, int h, int w, int kh,
+                  int kw, int sh, int sw, int ph, int pw, float* cols) {
+  const int out_h = (h + 2 * ph - kh) / sh + 1;
+  const int out_w = (w + 2 * pw - kw) / sw + 1;
+  const int ck = channels * kh * kw;
+  for (int c = 0; c < ck; ++c) {
+    const int woff = c % kw;
+    const int hoff = (c / kw) % kh;
+    const int cim = c / (kh * kw);
+    for (int oh = 0; oh < out_h; ++oh) {
+      const int ih = oh * sh - ph + hoff;
+      for (int ow = 0; ow < out_w; ++ow) {
+        const int iw = ow * sw - pw + woff;
+        cols[(c * out_h + oh) * out_w + ow] =
+            (ih >= 0 && ih < h && iw >= 0 && iw < w)
+                ? img[(cim * h + ih) * w + iw]
+                : 0.0f;
+      }
+    }
+  }
+}
+
+// col2im: scatter-add inverse of im2col
+void bigdl_col2im(const float* cols, int channels, int h, int w, int kh,
+                  int kw, int sh, int sw, int ph, int pw, float* img) {
+  const int out_h = (h + 2 * ph - kh) / sh + 1;
+  const int out_w = (w + 2 * pw - kw) / sw + 1;
+  const int ck = channels * kh * kw;
+  std::memset(img, 0, sizeof(float) * channels * h * w);
+  for (int c = 0; c < ck; ++c) {
+    const int woff = c % kw;
+    const int hoff = (c / kw) % kh;
+    const int cim = c / (kh * kw);
+    for (int oh = 0; oh < out_h; ++oh) {
+      const int ih = oh * sh - ph + hoff;
+      if (ih < 0 || ih >= h) continue;
+      for (int ow = 0; ow < out_w; ++ow) {
+        const int iw = ow * sw - pw + woff;
+        if (iw >= 0 && iw < w)
+          img[(cim * h + ih) * w + iw] += cols[(c * out_h + oh) * out_w + ow];
+      }
+    }
+  }
+}
+
+// max-pool forward with argmax indices. input [C,H,W]
+void bigdl_maxpool_fwd(const float* in, int channels, int h, int w, int kh,
+                       int kw, int sh, int sw, int ph, int pw, float* out,
+                       int32_t* idx) {
+  const int out_h = (h + 2 * ph - kh) / sh + 1;
+  const int out_w = (w + 2 * pw - kw) / sw + 1;
+  for (int c = 0; c < channels; ++c) {
+    for (int oh = 0; oh < out_h; ++oh) {
+      for (int ow = 0; ow < out_w; ++ow) {
+        float best = -3.4e38f;
+        int32_t best_i = -1;
+        for (int i = 0; i < kh; ++i) {
+          const int ih = oh * sh - ph + i;
+          if (ih < 0 || ih >= h) continue;
+          for (int j = 0; j < kw; ++j) {
+            const int iw = ow * sw - pw + j;
+            if (iw < 0 || iw >= w) continue;
+            const float v = in[(c * h + ih) * w + iw];
+            if (v > best) { best = v; best_i = ih * w + iw; }
+          }
+        }
+        out[(c * out_h + oh) * out_w + ow] = best;
+        idx[(c * out_h + oh) * out_w + ow] = best_i;
+      }
+    }
+  }
+}
+
+void bigdl_maxpool_bwd(const float* grad_out, const int32_t* idx,
+                       int channels, int h, int w, int out_h, int out_w,
+                       float* grad_in) {
+  std::memset(grad_in, 0, sizeof(float) * channels * h * w);
+  for (int c = 0; c < channels; ++c)
+    for (int o = 0; o < out_h * out_w; ++o) {
+      const int32_t i = idx[c * out_h * out_w + o];
+      if (i >= 0) grad_in[c * h * w + i] += grad_out[c * out_h * out_w + o];
+    }
+}
+
+}  // extern "C"

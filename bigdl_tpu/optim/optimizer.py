@@ -309,12 +309,6 @@ class Optimizer:
                 f"[Iteration {self.state['neval']}] Trained {n} records in "
                 f"{t_end - t_start:.4f} seconds. Throughput is {throughput:.1f} "
                 f"records/second. Loss is {loss:.5f}.")
-            if self._train_summary is not None:
-                self._train_summary.add_scalar("Loss", loss, self.state["neval"])
-                self._train_summary.add_scalar("Throughput", throughput, self.state["neval"])
-                lr = self.optim_method.get_learning_rate()
-                self._train_summary.add_scalar("LearningRate", lr, self.state["neval"])
-
             self.state["_epoch_boundary"] = False
             if records_this_epoch >= dataset_size:
                 self.state["epoch"] += 1
@@ -327,6 +321,23 @@ class Optimizer:
                 log.info(f"[Epoch {self.state['epoch'] - 1}] finished in "
                          f"{time.perf_counter() - epoch_start:.2f}s")
                 epoch_start = time.perf_counter()
+            if self._train_summary is not None:
+                ts = self._train_summary
+                # default: scalars on, Parameters histograms opt-in
+                # (TrainSummary.scala:64-88)
+                gate = getattr(ts, "should_write",
+                               lambda tag, st: tag != "Parameters")
+                if gate("Loss", self.state):
+                    ts.add_scalar("Loss", loss, self.state["neval"])
+                if gate("Throughput", self.state):
+                    ts.add_scalar("Throughput", throughput, self.state["neval"])
+                if gate("LearningRate", self.state):
+                    lr = self.optim_method.get_learning_rate()
+                    ts.add_scalar("LearningRate", lr, self.state["neval"])
+                if gate("Parameters", self.state) and hasattr(ts, "add_histogram"):
+                    for pname, arr in step.params.items():
+                        ts.add_histogram(pname, np.asarray(arr),
+                                         self.state["neval"])
             if self._val_trigger is not None and self._val_trigger(self.state):
                 step.sync_to_model()
                 self._validate(eval_step)

@@ -1,0 +1,10 @@
+"""TensorBoard-compatible visualization (SURVEY §2.10): Summary API over
+TFRecord event files with masked-CRC32C framing from the native layer."""
+
+from bigdl_tpu.visualization.summary import (Summary, TrainSummary,
+                                             ValidationSummary)
+from bigdl_tpu.visualization.tensorboard import (EventWriter, FileWriter,
+                                                 RecordWriter, read_scalar)
+
+__all__ = ["Summary", "TrainSummary", "ValidationSummary", "FileWriter",
+           "EventWriter", "RecordWriter", "read_scalar"]

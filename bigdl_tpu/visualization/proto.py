@@ -1,0 +1,151 @@
+"""Minimal protobuf wire-format codec for TensorBoard Event files.
+
+The reference ships protoc-generated Java for the TF ``Event``/``Summary``
+protos (``spark/dl/src/main/java/org/tensorflow/...``, SURVEY §2.1) and
+writes them from ``visualization/tensorboard/*.scala``.  Here the three
+messages we emit (Event, Summary, HistogramProto) are hand-encoded on the
+wire format directly — no protobuf runtime dependency, byte-compatible
+with TensorBoard's parser.
+
+Wire layout used:
+  Event        { double wall_time=1; int64 step=2; string file_version=3;
+                 Summary summary=5; }
+  Summary      { repeated Value value=1; }
+  Value        { string tag=1; float simple_value=2; HistogramProto histo=5; }
+  HistogramProto { double min=1,max=2,num=3,sum=4,sum_squares=5;
+                 repeated double bucket_limit=6 [packed], bucket=7 [packed]; }
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+__all__ = ["encode_event", "decode_event", "encode_histogram"]
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _int64(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v)
+
+
+def _packed_doubles(field: int, vals) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vals)
+    return _len_delim(field, payload)
+
+
+def encode_histogram(mn: float, mx: float, num: float, total: float,
+                     sum_squares: float, bucket_limits, buckets) -> bytes:
+    out = _double(1, mn) + _double(2, mx) + _double(3, num) + \
+        _double(4, total) + _double(5, sum_squares)
+    out += _packed_doubles(6, bucket_limits)
+    out += _packed_doubles(7, buckets)
+    return out
+
+
+def encode_event(wall_time: float, step: Optional[int] = None,
+                 file_version: Optional[str] = None,
+                 scalars: Optional[List[Tuple[str, float]]] = None,
+                 histograms: Optional[List[Tuple[str, bytes]]] = None
+                 ) -> bytes:
+    """Serialize one Event proto."""
+    out = _double(1, wall_time)
+    if step is not None:
+        out += _int64(2, step)
+    if file_version is not None:
+        out += _len_delim(3, file_version.encode())
+    values = b""
+    for tag, v in scalars or []:
+        values += _len_delim(1, _len_delim(1, tag.encode()) + _float(2, v))
+    for tag, histo in histograms or []:
+        values += _len_delim(1, _len_delim(1, tag.encode()) +
+                             _len_delim(5, histo))
+    if values:
+        out += _len_delim(5, values)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decoding (for FileReader.read_scalar)
+# ---------------------------------------------------------------------------
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+            yield field, wire, v
+        elif wire == 1:
+            yield field, wire, buf[i:i + 8]
+            i += 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            yield field, wire, buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            yield field, wire, buf[i:i + 4]
+            i += 4
+        else:  # pragma: no cover
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def decode_event(buf: bytes) -> dict:
+    """Decode an Event into {wall_time, step, scalars: [(tag, value)]}."""
+    ev = {"wall_time": 0.0, "step": 0, "scalars": []}
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == 1:
+            ev["wall_time"] = struct.unpack("<d", val)[0]
+        elif field == 2 and wire == 0:
+            ev["step"] = val
+        elif field == 5 and wire == 2:
+            for f2, w2, v2 in _fields(val):
+                if f2 == 1 and w2 == 2:
+                    tag, sv = None, None
+                    for f3, w3, v3 in _fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            tag = v3.decode()
+                        elif f3 == 2 and w3 == 5:
+                            sv = struct.unpack("<f", v3)[0]
+                    if tag is not None and sv is not None:
+                        ev["scalars"].append((tag, sv))
+    return ev

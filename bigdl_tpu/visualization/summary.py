@@ -1,0 +1,112 @@
+"""Summary API: TrainSummary / ValidationSummary (SURVEY §2.10).
+
+Mirrors ``visualization/Summary.scala`` (``addScalar :44``,
+``addHistogram :61`` with TF-style exponential buckets ``:144-180``) and
+``TrainSummary.scala:64-88`` (per-tag triggers: Loss/LearningRate/
+Throughput written by default, Parameters histograms opt-in)."""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.visualization import proto
+from bigdl_tpu.visualization.tensorboard import FileWriter, read_scalar
+
+__all__ = ["Summary", "TrainSummary", "ValidationSummary",
+           "histogram_proto"]
+
+
+def _bucket_limits() -> List[float]:
+    """TF's exponential histogram buckets (Summary.scala:144-180): positive
+    limits 1e-12 * 1.1^k, mirrored negative, with 0-straddling edges."""
+    pos = []
+    v = 1e-12
+    while v < 1e20:
+        pos.append(v)
+        v *= 1.1
+    return [-x for x in reversed(pos)] + pos + [float("inf")]
+
+
+_LIMITS = None
+
+
+def histogram_proto(values) -> bytes:
+    """Build a HistogramProto payload from an array of values."""
+    global _LIMITS
+    if _LIMITS is None:
+        _LIMITS = np.asarray(_bucket_limits())
+    v = np.asarray(values, np.float64).reshape(-1)
+    if v.size == 0:
+        v = np.zeros(1)
+    idx = np.searchsorted(_LIMITS, v, side="left")
+    counts = np.bincount(idx, minlength=len(_LIMITS)).astype(np.float64)
+    # trim empty leading/trailing buckets (TF does the same to keep protos small)
+    nz = np.nonzero(counts)[0]
+    lo, hi = int(nz[0]), int(nz[-1]) + 1
+    lo = max(lo - 1, 0)
+    hi = min(hi + 1, len(_LIMITS))
+    return proto.encode_histogram(
+        float(v.min()), float(v.max()), float(v.size), float(v.sum()),
+        float((v * v).sum()), _LIMITS[lo:hi].tolist(),
+        counts[lo:hi].tolist())
+
+
+class Summary:
+    """Base writer bound to <log_dir>/<app_name>/<folder>."""
+
+    folder = ""
+
+    def __init__(self, log_dir: str, app_name: str):
+        self.log_dir = os.path.join(log_dir, app_name, self.folder)
+        self._writer = FileWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> "Summary":
+        self._writer.add_scalar(tag, value, step)
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+        self._writer.add_histogram(tag, values, step)
+        return self
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float, float]]:
+        self._writer.flush()
+        return read_scalar(self.log_dir, tag)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class TrainSummary(Summary):
+    """Training-side summary with per-tag trigger gating
+    (``TrainSummary.scala:32-88``). Default tags Loss/LearningRate/
+    Throughput are always written; 'Parameters' histograms are opt-in via
+    ``set_summary_trigger("Parameters", Trigger.several_iteration(n))``."""
+
+    folder = "train"
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name)
+        self._triggers: Dict[str, object] = {}
+
+    def set_summary_trigger(self, tag: str, trigger) -> "TrainSummary":
+        self._triggers[tag] = trigger
+        return self
+
+    def trigger_for(self, tag: str):
+        return self._triggers.get(tag)
+
+    def should_write(self, tag: str, state: dict) -> bool:
+        trig = self._triggers.get(tag)
+        if trig is None:
+            return tag != "Parameters"  # params opt-in, scalars default-on
+        return bool(trig(state))
+
+
+class ValidationSummary(Summary):
+    """Validation-side scalars (one per ValidationMethod)."""
+
+    folder = "validation"

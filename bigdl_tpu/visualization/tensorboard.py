@@ -1,0 +1,142 @@
+"""TensorBoard event-file IO: TFRecord framing + async writer + reader.
+
+Mirrors the reference's ``visualization/tensorboard/`` stack:
+``RecordWriter.scala:30`` (length + masked-CRC32C framing via
+``netty/Crc32c.java`` — here the native C++ ``bigdl_masked_crc32c``),
+``EventWriter.scala:31`` (dedicated writer thread, ``tfevents`` file
+naming), ``FileWriter.scala:31`` (async queue facade), and
+``FileReader.scala`` (scalar read-back)."""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import List, Tuple
+
+from bigdl_tpu import native
+from bigdl_tpu.visualization import proto
+
+__all__ = ["RecordWriter", "EventWriter", "FileWriter", "read_scalar"]
+
+
+class RecordWriter:
+    """TFRecord framing: <len u64><masked crc of len u32><data><masked crc
+    of data u32> (``RecordWriter.scala:33-44``)."""
+
+    def __init__(self, fileobj):
+        self._f = fileobj
+
+    def write(self, data: bytes) -> None:
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", native.masked_crc32c(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", native.masked_crc32c(data)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+
+class EventWriter:
+    """Writer thread draining an event queue into one tfevents file
+    (``EventWriter.scala:31-76``)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 10.0):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self.path = os.path.join(log_dir, fname)
+        self._file = open(self.path, "ab")
+        self._rec = RecordWriter(self._file)
+        self._q: "queue.Queue" = queue.Queue()
+        self._flush_secs = flush_secs
+        self._closed = threading.Event()
+        # version header event, like EventWriter's first write
+        self._rec.write(proto.encode_event(time.time(),
+                                           file_version="brain.Event:2"))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def add_event(self, event_bytes: bytes) -> None:
+        self._q.put(event_bytes)
+
+    def _run(self) -> None:
+        last_flush = time.time()
+        while not (self._closed.is_set() and self._q.empty()):
+            try:
+                ev = self._q.get(timeout=0.2)
+            except queue.Empty:
+                ev = None
+            if ev is not None:
+                self._rec.write(ev)
+            if time.time() - last_flush > self._flush_secs:
+                self._rec.flush()
+                last_flush = time.time()
+        self._rec.flush()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=10)
+        self._file.close()
+
+
+class FileWriter:
+    """User-facing async writer (``FileWriter.scala:31``)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 10.0):
+        self.log_dir = log_dir
+        self._writer = EventWriter(log_dir, flush_secs)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> "FileWriter":
+        self._writer.add_event(proto.encode_event(
+            time.time(), step=step, scalars=[(tag, float(value))]))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "FileWriter":
+        from bigdl_tpu.visualization.summary import histogram_proto
+
+        self._writer.add_event(proto.encode_event(
+            time.time(), step=step,
+            histograms=[(tag, histogram_proto(values))]))
+        return self
+
+    def flush(self) -> None:
+        self._writer._rec.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+def _iter_records(path: str):
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            f.read(4)  # header crc
+            data = f.read(length)
+            f.read(4)  # data crc
+            yield data
+
+
+def read_scalar(log_dir: str, tag: str) -> List[Tuple[int, float, float]]:
+    """Read back all (step, value, wall_time) triples for a scalar tag —
+    the reference's ``FileReader.readScalar`` powering
+    ``TrainSummary.readScalar``."""
+    out = []
+    if not os.path.isdir(log_dir):
+        return out
+    for fname in sorted(os.listdir(log_dir)):
+        if "tfevents" not in fname:
+            continue
+        for rec in _iter_records(os.path.join(log_dir, fname)):
+            ev = proto.decode_event(rec)
+            for t, v in ev["scalars"]:
+                if t == tag:
+                    out.append((ev["step"], v, ev["wall_time"]))
+    out.sort(key=lambda r: r[0])
+    return out
