@@ -1,0 +1,156 @@
+"""Dataset readers (SURVEY §2.6 ``pyspark/bigdl/dataset/``: mnist.py IDX
+parsing, news20; plus the Scala ImageFolder/SeqFileFolder factories).
+
+Readers parse the standard on-disk formats when present; with no files
+(this image has zero egress) they fall back to deterministic synthetic
+data of the right shapes so pipelines/models/benchmarks run anywhere —
+the reference's own perf harness does the same
+(``models/utils/DistriOptimizerPerf.scala`` synthetic batches)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.image import LabeledImage
+
+__all__ = ["load_mnist", "load_cifar10", "load_news20", "image_folder",
+           "TRAIN_MEAN", "TRAIN_STD"]
+
+# MNIST normalization constants (pyspark/bigdl/dataset/mnist.py)
+TRAIN_MEAN = 0.13066047740239506 * 255
+TRAIN_STD = 0.3081078 * 255
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    """Parse an IDX3 image file (``mnist.py read_data_sets``)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad IDX image magic {magic}"
+        return np.frombuffer(f.read(n * rows * cols), np.uint8).reshape(
+            n, rows, cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad IDX label magic {magic}"
+        return np.frombuffer(f.read(n), np.uint8)
+
+
+def _synthetic_images(n: int, h: int, w: int, c: int, classes: int,
+                      seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-dependent synthetic images: each class gets a
+    distinct mean pattern so models can actually fit them."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n)
+    base = rng.uniform(0, 255, (classes, h, w, c))
+    imgs = np.clip(base[labels] + rng.normal(0, 30, (n, h, w, c)),
+                   0, 255).astype(np.uint8)
+    if c == 1:
+        imgs = imgs[..., 0]
+    return imgs, labels.astype(np.int64)
+
+
+def load_mnist(data_dir: Optional[str] = None, split: str = "train",
+               synthetic_size: int = 1024
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Return (images [N,28,28] uint8, labels [N] int64 0-based).
+
+    Looks for the standard IDX files (train-images-idx3-ubyte[.gz], ...)
+    under ``data_dir``; synthesizes data when absent."""
+    names = {"train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+             "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+    if data_dir:
+        img_base, lbl_base = names[split]
+        for suffix in ("", ".gz"):
+            ip = os.path.join(data_dir, img_base + suffix)
+            lp = os.path.join(data_dir, lbl_base + suffix)
+            if os.path.exists(ip) and os.path.exists(lp):
+                return _read_idx_images(ip), \
+                    _read_idx_labels(lp).astype(np.int64)
+    return _synthetic_images(synthetic_size, 28, 28, 1, 10,
+                             seed=0 if split == "train" else 1)
+
+
+def load_cifar10(data_dir: Optional[str] = None, split: str = "train",
+                 synthetic_size: int = 1024
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Return (images [N,32,32,3] uint8, labels [N] int64).
+
+    Parses the python-pickle CIFAR-10 batches (cifar-10-batches-py) when
+    present; synthesizes otherwise (models/vgg reads CIFAR the same way)."""
+    if data_dir:
+        batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+        if os.path.isdir(batch_dir):
+            files = [f"data_batch_{i}" for i in range(1, 6)] \
+                if split == "train" else ["test_batch"]
+            imgs, labels = [], []
+            for fn in files:
+                with open(os.path.join(batch_dir, fn), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                imgs.append(np.asarray(d[b"data"], np.uint8)
+                            .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                labels.append(np.asarray(d[b"labels"], np.int64))
+            return np.concatenate(imgs), np.concatenate(labels)
+    return _synthetic_images(synthetic_size, 32, 32, 3, 10,
+                             seed=2 if split == "train" else 3)
+
+
+_NEWS_TOPICS = [
+    "computer graphics rendering pixels shader display",
+    "hockey team goal season player ice score win",
+    "space orbit nasa launch satellite moon rocket",
+    "medicine doctor disease patient treatment health",
+    "politics government election vote law president",
+]
+
+
+def load_news20(data_dir: Optional[str] = None, synthetic_size: int = 500
+                ) -> List[Tuple[str, int]]:
+    """(text, label) pairs in the 20-newsgroups layout
+    (``news20.py``: one dir per group, one file per post); synthesizes
+    topic-worded documents when absent."""
+    if data_dir and os.path.isdir(data_dir):
+        out = []
+        groups = [g for g in sorted(os.listdir(data_dir))
+                  if os.path.isdir(os.path.join(data_dir, g))]
+        for label, group in enumerate(groups):
+            gdir = os.path.join(data_dir, group)
+            for fn in sorted(os.listdir(gdir)):
+                with open(os.path.join(gdir, fn), errors="ignore") as f:
+                    out.append((f.read(), label))
+        if out:
+            return out
+    rng = np.random.default_rng(4)
+    out = []
+    for i in range(synthetic_size):
+        label = int(rng.integers(0, len(_NEWS_TOPICS)))
+        words = _NEWS_TOPICS[label].split()
+        doc = " ".join(rng.choice(words, size=30).tolist())
+        out.append((doc, label))
+    return out
+
+
+def image_folder(path: str) -> List[LabeledImage]:
+    """ImageFolder layout (``DataSet.scala:319`` ImageFolder.paths): one
+    subdirectory per class, images inside. Requires PIL for decode."""
+    from PIL import Image
+
+    out = []
+    classes = [c for c in sorted(os.listdir(path))
+               if os.path.isdir(os.path.join(path, c))]
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(path, cls)
+        for fn in sorted(os.listdir(cdir)):
+            img = np.asarray(Image.open(os.path.join(cdir, fn))
+                             .convert("RGB"))
+            out.append(LabeledImage(img, float(label)))
+    return out

@@ -1,0 +1,309 @@
+"""Image transforms (SURVEY §2.6, ``dataset/image/`` — 24 files).
+
+The reference's image pipeline is a chain of ``Transformer`` stages over
+label-carrying image records: bytes decode → normalize → crop → flip →
+color jitter → PCA lighting → batch.  Here the record type is
+:class:`LabeledImage` (uint8/float32 HWC array + label), the stages are
+the same capabilities re-expressed over NumPy, and the multithreaded
+batcher (``MTLabeledBGRImgToBatch.scala``) rides the native C++ assembler
+(``bigdl_tpu.native.batch_crop_normalize``)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.rng import RNG
+
+__all__ = [
+    "LabeledImage", "BytesToImage", "ImageNormalizer", "CenterCropper",
+    "RandomCropper", "HFlip", "ColorJitter", "Lighting", "ImageToSample",
+    "GreyImgNormalizer", "GreyImgToSample", "MTImageToBatch",
+    "channel_mean_std",
+]
+
+
+class LabeledImage:
+    """One image record: HWC ndarray (uint8 or float32) + float label
+    (the reference's ``LabeledBGRImage``/``LabeledGreyImage``)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: np.ndarray, label: float = 0.0):
+        self.data = data
+        self.label = label
+
+    @property
+    def height(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.data.shape[1]
+
+
+class BytesToImage(Transformer):
+    """(bytes, label) → LabeledImage.  The reference decodes JPEG via
+    javax.imageio (``BytesToBGRImg.scala``); here raw byte records carry a
+    (h, w, c) header-free layout supplied at construction, or decode via
+    PIL when available."""
+
+    def __init__(self, height: Optional[int] = None,
+                 width: Optional[int] = None, channels: int = 3):
+        self.height, self.width, self.channels = height, width, channels
+
+    def apply(self, it: Iterator) -> Iterator[LabeledImage]:
+        for rec in it:
+            data, label = rec
+            if isinstance(data, np.ndarray):
+                yield LabeledImage(data, label)
+                continue
+            if self.height is not None:
+                arr = np.frombuffer(data, np.uint8).reshape(
+                    self.height, self.width, self.channels)
+                yield LabeledImage(arr, label)
+            else:
+                import io
+
+                from PIL import Image  # optional path
+
+                arr = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+                yield LabeledImage(arr, label)
+
+
+class ImageNormalizer(Transformer):
+    """Per-channel (x - mean) / std, uint8 → float32
+    (``BGRImgNormalizer.scala``)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            data = (img.data.astype(np.float32) - self.mean) / self.std
+            yield LabeledImage(data, img.label)
+
+
+class CenterCropper(Transformer):
+    """Deterministic center crop (``BGRImgCropper`` CropCenter)."""
+
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            oy = (img.height - self.crop_h) // 2
+            ox = (img.width - self.crop_w) // 2
+            yield LabeledImage(
+                img.data[oy:oy + self.crop_h, ox:ox + self.crop_w],
+                img.label)
+
+
+class RandomCropper(Transformer):
+    """Uniform random crop (``BGRImgRdmCropper.scala``)."""
+
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            oy = int(RNG.randint(0, img.height - self.crop_h + 1))
+            ox = int(RNG.randint(0, img.width - self.crop_w + 1))
+            yield LabeledImage(
+                img.data[oy:oy + self.crop_h, ox:ox + self.crop_w],
+                img.label)
+
+
+class HFlip(Transformer):
+    """Random horizontal flip with probability p (``HFlip.scala``)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            if RNG.uniform() < self.p:
+                yield LabeledImage(img.data[:, ::-1], img.label)
+            else:
+                yield img
+
+
+class ColorJitter(Transformer):
+    """Random brightness/contrast/saturation in random order
+    (``ColorJitter.scala``): each scales toward/away from a reference
+    statistic by alpha ~ U[1-var, 1+var]."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    @staticmethod
+    def _grayscale(x: np.ndarray) -> np.ndarray:
+        # luma weights over the last (channel) axis, broadcast back
+        g = x @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        return np.repeat(g[..., None], x.shape[-1], axis=-1)
+
+    def _blend(self, x, target, var):
+        alpha = 1.0 + (RNG.uniform() * 2.0 - 1.0) * var
+        return alpha * x + (1.0 - alpha) * target
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            x = img.data.astype(np.float32)
+            order = RNG.permutation(3)
+            for op in order:
+                if op == 0 and self.brightness > 0:
+                    x = self._blend(x, 0.0, self.brightness)
+                elif op == 1 and self.contrast > 0:
+                    x = self._blend(x, self._grayscale(x).mean(),
+                                    self.contrast)
+                elif op == 2 and self.saturation > 0:
+                    x = self._blend(x, self._grayscale(x), self.saturation)
+            yield LabeledImage(x, img.label)
+
+
+class Lighting(Transformer):
+    """AlexNet-style PCA lighting noise (``Lighting.scala``): add
+    eigvec @ (alpha * eigval), alpha ~ N(0, 0.1) per channel."""
+
+    # ImageNet RGB eigen decomposition (public constants)
+    EIGVAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    EIGVEC = np.asarray([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha_std: float = 0.1):
+        self.alpha_std = alpha_std
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            alpha = np.asarray(RNG.normal(0.0, self.alpha_std, size=3),
+                               np.float32)
+            noise = self.EIGVEC @ (alpha * self.EIGVAL)
+            yield LabeledImage(img.data.astype(np.float32) + noise,
+                               img.label)
+
+
+class ImageToSample(Transformer):
+    """LabeledImage → Sample with CHW feature layout
+    (``BGRImgToSample.scala``); labels stay 0-based int64."""
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[Sample]:
+        for img in it:
+            feat = np.ascontiguousarray(
+                img.data.astype(np.float32).transpose(2, 0, 1))
+            yield Sample(feat, np.int64(img.label))
+
+
+class GreyImgNormalizer(Transformer):
+    """Single-channel (x - mean) / std (``GreyImgNormalizer.scala``,
+    the MNIST path)."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = float(mean), float(std)
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[LabeledImage]:
+        for img in it:
+            yield LabeledImage(
+                (img.data.astype(np.float32) - self.mean) / self.std,
+                img.label)
+
+
+class GreyImgToSample(Transformer):
+    """[H,W] or [H,W,1] grey image → Sample [1,H,W]."""
+
+    def apply(self, it: Iterator[LabeledImage]) -> Iterator[Sample]:
+        for img in it:
+            d = img.data.astype(np.float32)
+            if d.ndim == 3:
+                d = d[..., 0]
+            yield Sample(d[None, :, :], np.int64(img.label))
+
+
+class MTImageToBatch(Transformer):
+    """Multithreaded crop+normalize+flip straight into an NCHW float32
+    batch via the native C++ assembler — the reference's
+    ``MTLabeledBGRImgToBatch.scala`` hot path.  Consumes uint8
+    LabeledImages of uniform size; emits (features, labels) ndarray
+    pairs."""
+
+    def __init__(self, batch_size: int, crop_h: int, crop_w: int,
+                 mean: Sequence[float], std: Sequence[float],
+                 random_crop: bool = True, hflip: bool = True,
+                 num_threads: int = 0):
+        self.batch_size = batch_size
+        self.crop_h, self.crop_w = crop_h, crop_w
+        self.mean, self.std = mean, std
+        self.random_crop = random_crop
+        self.hflip = hflip
+        self.num_threads = num_threads
+
+    def apply(self, it: Iterator[LabeledImage]):
+        from bigdl_tpu import native
+
+        buf: List[LabeledImage] = []
+        for img in it:
+            buf.append(img)
+            if len(buf) == self.batch_size:
+                yield self._assemble(native, buf)
+                buf = []
+        if buf:
+            yield self._assemble(native, buf)
+
+    def _assemble(self, native, buf: List[LabeledImage]):
+        n = len(buf)
+        imgs = np.stack([b.data for b in buf])
+        h, w = imgs.shape[1], imgs.shape[2]
+        if self.random_crop:
+            oy = np.asarray(RNG.randint(0, h - self.crop_h + 1, size=n),
+                            np.int32)
+            ox = np.asarray(RNG.randint(0, w - self.crop_w + 1, size=n),
+                            np.int32)
+        else:
+            oy = np.full(n, (h - self.crop_h) // 2, np.int32)
+            ox = np.full(n, (w - self.crop_w) // 2, np.int32)
+        flip = (np.asarray(RNG.uniform(size=n)) < 0.5) \
+            if self.hflip else np.zeros(n, bool)
+        if imgs.dtype == np.uint8:
+            feats = native.batch_crop_normalize(
+                imgs, self.crop_h, self.crop_w, oy, ox,
+                flip.astype(np.uint8), self.mean, self.std, self.num_threads)
+        else:
+            # float input (e.g. after ColorJitter/Lighting): numpy path —
+            # the native kernel is uint8-only
+            mean = np.asarray(self.mean, np.float32)
+            std = np.asarray(self.std, np.float32)
+            feats = np.empty((n, imgs.shape[3], self.crop_h, self.crop_w),
+                             np.float32)
+            for i in range(n):
+                patch = imgs[i, oy[i]:oy[i] + self.crop_h,
+                             ox[i]:ox[i] + self.crop_w, :]
+                if flip[i]:
+                    patch = patch[:, ::-1, :]
+                feats[i] = ((patch.astype(np.float32) - mean) / std) \
+                    .transpose(2, 0, 1)
+        labels = np.asarray([b.label for b in buf], np.int64)
+        return feats, labels
+
+
+def channel_mean_std(images: Iterator[LabeledImage]
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Dataset-wide per-channel statistics (the reference computes these
+    offline for BGRImgNormalizer configs)."""
+    count = 0
+    s = s2 = 0.0
+    for img in images:
+        x = img.data.astype(np.float64)
+        x = x.reshape(-1, 1) if x.ndim == 2 else x.reshape(-1, x.shape[-1])
+        s = s + x.sum(axis=0)
+        s2 = s2 + (x * x).sum(axis=0)
+        count += x.shape[0]
+    mean = s / count
+    std = np.sqrt(s2 / count - mean * mean)
+    return mean.astype(np.float32), std.astype(np.float32)

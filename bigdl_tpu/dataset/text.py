@@ -1,0 +1,192 @@
+"""Text transforms (SURVEY §2.6, ``dataset/text/`` — 8 files).
+
+The reference's text path: sentence split/tokenize (OpenNLP) → Dictionary
+→ TextToLabeledSentence (token→index) → LabeledSentenceToSample (one-hot
+or index features, shifted-label targets for LM) → padded batching.
+Re-expressed here with a regex tokenizer and NumPy; variable lengths are
+handled by bucketed padding so jit shapes stay static (SURVEY §7
+"variable-length sequences")."""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = [
+    "SentenceSplitter", "SentenceTokenizer", "Dictionary",
+    "TextToLabeledSentence", "LabeledSentence", "LabeledSentenceToSample",
+    "SentenceBiPadding", "BucketedPadding",
+]
+
+_SENT_RE = re.compile(r"(?<=[.!?])\s+")
+_TOKEN_RE = re.compile(r"[A-Za-z0-9']+|[.,!?;]")
+
+SENTENCE_START = "SENTENCE_START"
+SENTENCE_END = "SENTENCE_END"
+
+
+class SentenceSplitter(Transformer):
+    """text → sentences (``SentenceSplitter.scala``; regex instead of
+    OpenNLP's learned splitter)."""
+
+    def apply(self, it: Iterator[str]) -> Iterator[str]:
+        for text in it:
+            for s in _SENT_RE.split(text.strip()):
+                if s:
+                    yield s
+
+
+class SentenceTokenizer(Transformer):
+    """sentence → token list (``SentenceTokenizer.scala``)."""
+
+    def __init__(self, lower: bool = True):
+        self.lower = lower
+
+    def apply(self, it: Iterator[str]) -> Iterator[List[str]]:
+        for s in it:
+            if self.lower:
+                s = s.lower()
+            toks = _TOKEN_RE.findall(s)
+            if toks:
+                yield toks
+
+
+class SentenceBiPadding(Transformer):
+    """Wrap token lists with SENTENCE_START/SENTENCE_END markers
+    (``SentenceBiPadding.scala``)."""
+
+    def apply(self, it: Iterator[List[str]]) -> Iterator[List[str]]:
+        for toks in it:
+            yield [SENTENCE_START] + toks + [SENTENCE_END]
+
+
+class Dictionary:
+    """Vocabulary with frequency-ranked indices and an UNK bucket
+    (``Dictionary.scala``: vocabSize keeps the top-k words, the rest map
+    to an out-of-vocab index)."""
+
+    UNK = "<unk>"
+
+    def __init__(self, sentences: Optional[Iterable[List[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self.word2index: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        if sentences is not None:
+            counts = Counter(tok for s in sentences for tok in s)
+            top = counts.most_common(vocab_size)
+            for w, _ in top:
+                self.word2index[w] = len(self.index2word)
+                self.index2word.append(w)
+        if self.UNK not in self.word2index:
+            self.word2index[self.UNK] = len(self.index2word)
+            self.index2word.append(self.UNK)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.index2word)
+
+    def index(self, word: str) -> int:
+        return self.word2index.get(word, self.word2index[self.UNK])
+
+    def word(self, idx: int) -> str:
+        return self.index2word[idx]
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            for w in self.index2word:
+                f.write(w + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Dictionary":
+        d = cls()
+        d.word2index, d.index2word = {}, []
+        with open(path) as f:
+            for line in f:
+                w = line.rstrip("\n")
+                d.word2index[w] = len(d.index2word)
+                d.index2word.append(w)
+        if cls.UNK not in d.word2index:
+            d.word2index[cls.UNK] = len(d.index2word)
+            d.index2word.append(cls.UNK)
+        return d
+
+
+class LabeledSentence:
+    """Token-index sequence + label sequence (``LabeledSentence.scala``)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: np.ndarray, label: np.ndarray):
+        self.data = np.asarray(data, np.int64)
+        self.label = np.asarray(label, np.int64)
+
+
+class TextToLabeledSentence(Transformer):
+    """Token list → LabeledSentence.  Language-model convention like the
+    reference (``TextToLabeledSentence.scala``): data = tokens[:-1],
+    label = tokens[1:] (next-word targets)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it: Iterator[List[str]]) -> Iterator[LabeledSentence]:
+        for toks in it:
+            idx = np.asarray([self.dictionary.index(t) for t in toks],
+                             np.int64)
+            if len(idx) < 2:
+                continue
+            yield LabeledSentence(idx[:-1], idx[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence → Sample, optionally one-hot features
+    (``LabeledSentenceToSample.scala``).  Fixed-length padding keeps jit
+    shapes static; pad index 0 like the reference's padding value."""
+
+    def __init__(self, vocab_size: int, fixed_length: Optional[int] = None,
+                 one_hot: bool = False):
+        self.vocab_size = vocab_size
+        self.fixed_length = fixed_length
+        self.one_hot = one_hot
+
+    def apply(self, it: Iterator[LabeledSentence]) -> Iterator[Sample]:
+        for s in it:
+            data, label = s.data, s.label
+            if self.fixed_length is not None:
+                L = self.fixed_length
+                data = np.pad(data[:L], (0, max(0, L - len(data))))
+                label = np.pad(label[:L], (0, max(0, L - len(label))))
+            if self.one_hot:
+                feat = np.zeros((len(data), self.vocab_size), np.float32)
+                feat[np.arange(len(data)), data] = 1.0
+            else:
+                feat = data
+            yield Sample(feat, label)
+
+
+class BucketedPadding(Transformer):
+    """Group sentences into length buckets and pad within the bucket —
+    bounded shape-polymorphism so XLA compiles one program per bucket,
+    not per length (SURVEY §7 hard-parts list)."""
+
+    def __init__(self, boundaries: Sequence[int]):
+        self.boundaries = sorted(boundaries)
+
+    def bucket_of(self, n: int) -> int:
+        for b in self.boundaries:
+            if n <= b:
+                return b
+        return self.boundaries[-1]
+
+    def apply(self, it: Iterator[LabeledSentence]) -> Iterator[LabeledSentence]:
+        for s in it:
+            b = self.bucket_of(len(s.data))
+            data = np.pad(s.data[:b], (0, max(0, b - len(s.data))))
+            label = np.pad(s.label[:b], (0, max(0, b - len(s.label))))
+            yield LabeledSentence(data, label)

@@ -1,0 +1,139 @@
+"""Image/text transforms + dataset readers (SURVEY §2.6 test analogue of
+the reference's dataset/ specs: transformer composition, batch shapes,
+normalization statistics)."""
+
+import numpy as np
+
+import bigdl_tpu.dataset.image as im
+import bigdl_tpu.dataset.text as tx
+from bigdl_tpu.dataset.datasets import (load_cifar10, load_mnist,
+                                        load_news20, TRAIN_MEAN, TRAIN_STD)
+from bigdl_tpu.dataset.sample import Sample
+
+
+def _imgs(n=8, h=12, w=12, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [im.LabeledImage(rng.integers(0, 255, (h, w, c), dtype=np.uint8),
+                            float(i % 3)) for i in range(n)]
+
+
+def test_normalize_then_crop_chain():
+    pipeline = im.ImageNormalizer([100, 110, 120], [50, 55, 60]) \
+        >> im.CenterCropper(8, 8) >> im.ImageToSample()
+    out = list(pipeline(iter(_imgs())))
+    assert len(out) == 8
+    assert out[0].feature.shape == (3, 8, 8)
+    assert out[0].label.dtype == np.int64
+
+
+def test_random_crop_and_flip_shapes():
+    pipe = im.RandomCropper(10, 10)
+    out = list(pipe(iter(_imgs())))
+    assert all(o.data.shape == (10, 10, 3) for o in out)
+    flipped = list(im.HFlip(1.0)(iter(_imgs(2))))
+    orig = _imgs(2)
+    np.testing.assert_array_equal(flipped[0].data, orig[0].data[:, ::-1])
+
+
+def test_color_jitter_and_lighting_run():
+    out = list(im.ColorJitter()(iter(_imgs(4))))
+    assert all(o.data.shape == (12, 12, 3) for o in out)
+    out = list(im.Lighting()(iter(_imgs(4))))
+    assert all(o.data.dtype == np.float32 for o in out)
+
+
+def test_mt_image_to_batch_native_path():
+    batcher = im.MTImageToBatch(4, 8, 8, [100.0] * 3, [50.0] * 3,
+                                random_crop=True, hflip=True)
+    batches = list(batcher(iter(_imgs(10))))
+    assert [b[0].shape[0] for b in batches] == [4, 4, 2]
+    feats, labels = batches[0]
+    assert feats.shape == (4, 3, 8, 8) and feats.dtype == np.float32
+    assert labels.dtype == np.int64
+
+
+def test_grey_img_mnist_path():
+    imgs, labels = load_mnist(None, "train", synthetic_size=64)
+    assert imgs.shape == (64, 28, 28) and labels.max() < 10
+    records = [im.LabeledImage(x, float(y)) for x, y in zip(imgs, labels)]
+    pipe = im.GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD) >> im.GreyImgToSample()
+    out = list(pipe(iter(records)))
+    assert out[0].feature.shape == (1, 28, 28)
+    # normalized data roughly zero-centered
+    assert abs(np.mean([o.feature.mean() for o in out])) < 2.0
+
+
+def test_cifar_reader_synthetic():
+    imgs, labels = load_cifar10(None, "train", synthetic_size=32)
+    assert imgs.shape == (32, 32, 32, 3)
+    assert labels.dtype == np.int64
+
+
+def test_channel_mean_std():
+    mean, std = im.channel_mean_std(iter(_imgs(16, seed=1)))
+    assert mean.shape == (3,) and std.shape == (3,)
+    assert (std > 0).all()
+
+
+# ---------------- text ----------------
+def test_tokenize_dictionary_roundtrip():
+    docs = ["The cat sat. The dog ran!", "A cat and a dog."]
+    sents = list(tx.SentenceSplitter()(iter(docs)))
+    assert len(sents) == 3
+    toks = list(tx.SentenceTokenizer()(iter(sents)))
+    d = tx.Dictionary(toks, vocab_size=10)
+    assert d.vocab_size <= 11
+    assert d.index("cat") != d.index(tx.Dictionary.UNK)
+    assert d.index("zebra") == d.index(tx.Dictionary.UNK)
+    assert d.word(d.index("cat")) == "cat"
+
+
+def test_dictionary_save_load(tmp_path):
+    d = tx.Dictionary([["a", "b", "c"]])
+    p = str(tmp_path / "vocab.txt")
+    d.save(p)
+    d2 = tx.Dictionary.load(p)
+    assert d2.index("b") == d.index("b")
+
+
+def test_text_to_sample_lm_convention():
+    toks = [["a", "b", "c", "d"]]
+    d = tx.Dictionary(toks)
+    ls = list(tx.TextToLabeledSentence(d)(iter(toks)))[0]
+    # next-word labels: label[i] == data[i+1]'s source token
+    assert len(ls.data) == 3 and len(ls.label) == 3
+    assert ls.label[0] == d.index("b")
+    samples = list(tx.LabeledSentenceToSample(d.vocab_size, fixed_length=5,
+                                              one_hot=True)(iter([ls])))
+    assert samples[0].feature.shape == (5, d.vocab_size)
+
+
+def test_bucketed_padding():
+    sents = [tx.LabeledSentence(np.arange(n), np.arange(n))
+             for n in (3, 7, 12)]
+    out = list(tx.BucketedPadding([4, 8, 16])(iter(sents)))
+    assert [len(o.data) for o in out] == [4, 8, 16]
+
+
+def test_news20_synthetic_and_sentence_padding():
+    docs = load_news20(None, synthetic_size=10)
+    assert len(docs) == 10
+    toks = list(tx.SentenceTokenizer()(iter([t for t, _ in docs])))
+    padded = list(tx.SentenceBiPadding()(iter(toks)))
+    assert padded[0][0] == tx.SENTENCE_START
+    assert padded[0][-1] == tx.SENTENCE_END
+
+
+def test_mt_batch_float_input_after_jitter():
+    pipe = im.ColorJitter() >> im.MTImageToBatch(
+        4, 8, 8, [0.0] * 3, [255.0] * 3, random_crop=False, hflip=False)
+    feats, labels = next(iter(pipe(iter(_imgs(4)))))
+    assert feats.shape == (4, 3, 8, 8) and feats.dtype == np.float32
+    assert np.isfinite(feats).all() and feats.max() <= 4.0
+
+
+def test_channel_mean_std_grey():
+    imgs, labels = load_mnist(None, "train", synthetic_size=8)
+    mean, std = im.channel_mean_std(
+        iter([im.LabeledImage(x, 0.0) for x in imgs]))
+    assert mean.shape == (1,) and std.shape == (1,)
