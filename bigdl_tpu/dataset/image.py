@@ -270,24 +270,9 @@ class MTImageToBatch(Transformer):
             ox = np.full(n, (w - self.crop_w) // 2, np.int32)
         flip = (np.asarray(RNG.uniform(size=n)) < 0.5) \
             if self.hflip else np.zeros(n, bool)
-        if imgs.dtype == np.uint8:
-            feats = native.batch_crop_normalize(
-                imgs, self.crop_h, self.crop_w, oy, ox,
-                flip.astype(np.uint8), self.mean, self.std, self.num_threads)
-        else:
-            # float input (e.g. after ColorJitter/Lighting): numpy path —
-            # the native kernel is uint8-only
-            mean = np.asarray(self.mean, np.float32)
-            std = np.asarray(self.std, np.float32)
-            feats = np.empty((n, imgs.shape[3], self.crop_h, self.crop_w),
-                             np.float32)
-            for i in range(n):
-                patch = imgs[i, oy[i]:oy[i] + self.crop_h,
-                             ox[i]:ox[i] + self.crop_w, :]
-                if flip[i]:
-                    patch = patch[:, ::-1, :]
-                feats[i] = ((patch.astype(np.float32) - mean) / std) \
-                    .transpose(2, 0, 1)
+        feats = native.batch_crop_normalize(
+            imgs, self.crop_h, self.crop_w, oy, ox,
+            flip.astype(np.uint8), self.mean, self.std, self.num_threads)
         labels = np.asarray([b.label for b in buf], np.int64)
         return feats, labels
 

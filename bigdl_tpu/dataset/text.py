@@ -173,10 +173,15 @@ class LabeledSentenceToSample(Transformer):
 class BucketedPadding(Transformer):
     """Group sentences into length buckets and pad within the bucket —
     bounded shape-polymorphism so XLA compiles one program per bucket,
-    not per length (SURVEY §7 hard-parts list)."""
+    not per length (SURVEY §7 hard-parts list).
+
+    Sentences longer than the largest boundary are TRUNCATED to it (the
+    largest boundary acts as max sequence length); a warning is logged the
+    first time this happens."""
 
     def __init__(self, boundaries: Sequence[int]):
         self.boundaries = sorted(boundaries)
+        self._warned_truncation = False
 
     def bucket_of(self, n: int) -> int:
         for b in self.boundaries:
@@ -185,8 +190,15 @@ class BucketedPadding(Transformer):
         return self.boundaries[-1]
 
     def apply(self, it: Iterator[LabeledSentence]) -> Iterator[LabeledSentence]:
+        import logging
+
         for s in it:
             b = self.bucket_of(len(s.data))
+            if len(s.data) > b and not self._warned_truncation:
+                logging.getLogger("bigdl_tpu.dataset").warning(
+                    "BucketedPadding: sentence of length %d truncated to "
+                    "largest bucket %d", len(s.data), b)
+                self._warned_truncation = True
             data = np.pad(s.data[:b], (0, max(0, b - len(s.data))))
             label = np.pad(s.label[:b], (0, max(0, b - len(s.label))))
             yield LabeledSentence(data, label)

@@ -283,7 +283,7 @@ def batch_crop_normalize(imgs: np.ndarray, crop_h: int, crop_w: int,
     ox = np.ascontiguousarray(ox, np.int32)
     flip = np.ascontiguousarray(flip, np.uint8)
     lib = _try_load()
-    if lib is not None:
+    if lib is not None and imgs.dtype == np.uint8:  # C++ kernel is uint8-only
         imgs = np.ascontiguousarray(imgs)
         out = np.empty((n, c, crop_h, crop_w), np.float32)
         lib.bigdl_batch_crop_normalize(

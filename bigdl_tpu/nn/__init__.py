@@ -15,4 +15,5 @@ from bigdl_tpu.nn.layers.normalization import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.shape import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.container_ext import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.rnn import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers.attention import *  # noqa: F401,F403
 from bigdl_tpu.nn.graph import Graph, Input, Node  # noqa: F401

@@ -1,0 +1,158 @@
+"""Attention layers: MultiHeadAttention, LayerNorm, TransformerBlock.
+
+The reference has no attention (SURVEY §5); these extend the module
+catalog so long-context transformer models are first-class citizens of
+the framework.  The compute core routes to ``bigdl_tpu.ops``: dense
+XLA-fused attention, the Pallas flash kernel, or a sequence-parallel
+strategy (ring / Ulysses over a mesh ``seq`` axis).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = ["LayerNorm", "MultiHeadAttention", "TransformerBlock"]
+
+
+class LayerNorm(Module):
+    """Layer normalization over the last dimension (extension beyond the
+    reference catalog; required by the transformer stack)."""
+
+    def __init__(self, normalized_size: int, eps: float = 1e-5,
+                 affine: bool = True):
+        super().__init__()
+        self.normalized_size = normalized_size
+        self.eps = eps
+        self.affine = affine
+        if affine:
+            self.weight = Parameter(jnp.ones((normalized_size,)))
+            self.bias = Parameter(jnp.zeros((normalized_size,)))
+
+    def update_output(self, input):
+        mu = jnp.mean(input, axis=-1, keepdims=True)
+        var = jnp.var(input, axis=-1, keepdims=True)
+        out = (input - mu) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            out = out * self._params["weight"] + self._params["bias"]
+        return out
+
+    def __repr__(self):
+        return f"LayerNorm({self.normalized_size})"
+
+
+class MultiHeadAttention(Module):
+    """Multi-head attention over [batch, seq, embed] inputs.
+
+    ``backend``: 'auto' (flash on TPU, dense elsewhere), 'dense',
+    'flash', or a callable ``f(q, k, v) -> out`` over [B, H, S, D] arrays
+    with causal/scale baked in — e.g. a shard_map-wrapped ring/ulysses
+    attention from
+    ``bigdl_tpu.parallel.sequence.make_sequence_parallel_attention``.
+    Custom callables do not receive masks; pass masking via the callable's
+    own construction.  ``dropout`` is applied to the attention context
+    (before the output projection) in training mode.
+
+    Input: a single tensor (self-attention), ``(x, mask)``,
+    ``(query, key, value)``, or ``(query, key, value, mask)``, where
+    tensors are [B, S, E] and mask broadcasts to [B, H, Sq, Sk]
+    (True = attend).
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dropout: float = 0.0, with_bias: bool = True,
+                 causal: bool = False, backend="auto"):
+        super().__init__()
+        assert embed_dim % num_heads == 0, \
+            "embed_dim must be divisible by num_heads"
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.dropout_p = dropout
+        self.backend = backend
+        self.q_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
+        self.k_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
+        self.v_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
+        self.out_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
+        if dropout > 0.0:
+            from bigdl_tpu.nn.layers.normalization import Dropout
+
+            self.drop = Dropout(dropout)
+
+    def _split(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim).transpose(
+            0, 2, 1, 3)
+
+    def _attend(self, q, k, v, mask):
+        from bigdl_tpu.ops import dot_product_attention, flash_attention
+
+        backend = self.backend
+        if callable(backend):
+            if mask is not None:
+                raise ValueError(
+                    "custom attention backends do not accept masks; bake "
+                    "masking into the callable")
+            return backend(q, k, v)
+        if backend == "auto":
+            backend = "flash" if jax.default_backend() == "tpu" else "dense"
+        if backend == "flash" and mask is None:
+            return flash_attention(q, k, v, causal=self.causal)
+        return dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+
+    def update_output(self, input):
+        mask = None
+        if isinstance(input, (tuple, list)):
+            if len(input) == 2:
+                x, mask = input
+                xq = xk = xv = x
+            elif len(input) == 3:
+                xq, xk, xv = input
+            elif len(input) == 4:
+                xq, xk, xv, mask = input
+            else:
+                raise ValueError("input must be x, (x, mask), (q, k, v) or "
+                                 "(q, k, v, mask)")
+        else:
+            xq = xk = xv = input
+        q = self._split(self.q_proj.forward(xq))
+        k = self._split(self.k_proj.forward(xk))
+        v = self._split(self.v_proj.forward(xv))
+        out = self._attend(q, k, v, mask)
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        if self.dropout_p > 0.0:
+            out = self.drop.forward(out)
+        return self.out_proj.forward(out)
+
+    def __repr__(self):
+        return (f"MultiHeadAttention({self.embed_dim}, heads="
+                f"{self.num_heads}, causal={self.causal})")
+
+
+class TransformerBlock(Module):
+    """Pre-norm transformer block: LN -> MHA -> residual, LN -> MLP ->
+    residual.  The building block of the long-context flagship model."""
+
+    def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
+                 dropout: float = 0.0, causal: bool = True, backend="auto"):
+        super().__init__()
+        self.ln1 = LayerNorm(embed_dim)
+        self.attn = MultiHeadAttention(embed_dim, num_heads, dropout=dropout,
+                                       causal=causal, backend=backend)
+        self.ln2 = LayerNorm(embed_dim)
+        self.fc1 = Linear(embed_dim, embed_dim * mlp_ratio)
+        self.fc2 = Linear(embed_dim * mlp_ratio, embed_dim)
+
+    def update_output(self, input):
+        x = input + self.attn.forward(self.ln1.forward(input))
+        h = self.fc1.forward(self.ln2.forward(x))
+        h = jax.nn.gelu(h)
+        return x + self.fc2.forward(h)

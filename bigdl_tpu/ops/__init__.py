@@ -1,0 +1,16 @@
+"""bigdl_tpu.ops — functional TPU ops: Pallas kernels and the attention
+family.
+
+The reference keeps its perf-critical inner kernels in
+``nn/NNPrimitive.scala`` (im2col/col2im/pooling hot loops) + MKL gemm; the
+TPU-native analogue is (a) XLA itself for conv/matmul/elementwise fusion and
+(b) Pallas kernels for ops XLA cannot fuse well — attention being the big
+one (SURVEY §5 "Long-context": absent in the reference, first-class here).
+"""
+
+from bigdl_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention,
+    flash_attention,
+    attention_partial,
+    combine_partials,
+)

@@ -1,0 +1,378 @@
+"""Scaled dot-product attention: dense reference + Pallas flash kernel.
+
+The reference framework has no attention of any kind (SURVEY §5
+"Long-context ... Absent"); this is new TPU-first design work.  Three
+entry points:
+
+- ``dot_product_attention``: dense O(S^2)-memory reference (XLA-fused).
+- ``flash_attention``: Pallas TPU kernel, O(S) memory, online softmax,
+  with a full flash *backward* (dq / dkv kernels) via ``jax.custom_vjp``.
+  Runs in interpret mode automatically off-TPU so tests exercise the same
+  code path on the CPU mesh.
+- ``attention_partial`` / ``combine_partials``: blockwise partial
+  attention state (acc, m, l) and its merge — the algebra ring attention
+  (``bigdl_tpu.parallel.sequence``) accumulates around the ICI ring.
+
+Shapes follow [batch, heads, seq, head_dim] throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "dot_product_attention",
+    "flash_attention",
+    "attention_partial",
+    "combine_partials",
+]
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# dense reference
+# ---------------------------------------------------------------------------
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                          scale: Optional[float] = None):
+    """Dense softmax(q k^T / sqrt(d)) v.  mask: broadcastable to
+    [B, H, Sq, Sk], True = attend."""
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# blockwise partial state (used by ring attention)
+# ---------------------------------------------------------------------------
+
+def attention_partial(q, k, v, scale: float, mask=None):
+    """One blockwise attention partial: returns (acc, m, l) where
+    out = acc / l after all partials are combined.  mask broadcastable to
+    [B, H, Sq, Sk], True = attend."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) = 1 would pollute l
+    p = jnp.where((s > _NEG_INF / 2)[..., :], jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def combine_partials(state_a, state_b):
+    """Merge two attention partials with the online-softmax rescale."""
+    acc_a, m_a, l_a = state_a
+    acc_b, m_b, l_b = state_b
+    m = jnp.maximum(m_a, m_b)
+    alpha = jnp.exp(m_a - m)
+    beta = jnp.exp(m_b - m)
+    return (acc_a * alpha[..., None] + acc_b * beta[..., None],
+            m, l_a * alpha + l_b * beta)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, causal: bool, block_k: int, kv_len: int):
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    q_start = pl.program_id(1) * block_q
+    nk = pl.cdiv(kv_len, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_len = block_q * pl.num_programs(1)
+            off = kv_len - q_len
+            q_pos = q_start + off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        q_len = block_q * pl.num_programs(1)
+        off = kv_len - q_len
+        hi = lax.min(nk, (q_start + off + block_q - 1) // block_k + 1)
+    else:
+        hi = nk
+    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = m + jnp.log(l_safe)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale: float, causal: bool, block_k: int, kv_len: int):
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    q_start = pl.program_id(1) * block_q
+    nk = pl.cdiv(kv_len, block_k)
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_len = block_q * pl.num_programs(1)
+            off = kv_len - q_len
+            q_pos = q_start + off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    if causal:
+        q_len = block_q * pl.num_programs(1)
+        off = kv_len - q_len
+        hi = lax.min(nk, (q_start + off + block_q - 1) // block_k + 1)
+    else:
+        hi = nk
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale: float, causal: bool,
+                block_q: int, q_len: int):
+    from jax.experimental import pallas as pl
+
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_start = pl.program_id(1) * block_k
+    kv_len = block_k * pl.num_programs(1)
+    nq = pl.cdiv(q_len, block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            off = kv_len - q_len
+            q_pos = i * block_q + off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        off = kv_len - q_len
+        lo = lax.max(0, (k_start - off) // block_q)
+    else:
+        lo = 0
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pick_block(s: int, pref: int) -> int:
+    if s <= pref:
+        return s
+    b = pref
+    while s % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    grid = (b * h, sq // bq)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=bk, kv_len=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, do, scale, causal,
+                    block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    dor = do.reshape(b * h, sq, d)
+    lser = lse.reshape(b * h, sq, 1)
+    deltar = delta.reshape(b * h, sq, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, kv_len=sk),
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, q_len=sq),
+        grid=(b * h, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, do, scale, causal,
+                           block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Flash attention (Pallas TPU kernel).  [B, H, S, D] in/out.
+
+    O(S) memory: softmax is computed online per q block over streamed k/v
+    blocks; backward recomputes p from the saved logsumexp (no S x S
+    materialization).  Off-TPU the kernels run in Pallas interpret mode so
+    the identical code path is testable on the CPU mesh.
+    """
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    if interpret is None:
+        interpret = _use_interpret()
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = _pick_block(sq, block_q), _pick_block(sk, block_k)
+    if not interpret and ((bq % 8 and bq != sq) or (bk % 8 and bk != sk)):
+        # shapes the Mosaic tiling can't express — dense fallback
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
