@@ -1,0 +1,153 @@
+"""TFRecord reading and a minimal TF ``Example`` proto parser.
+
+The reference reads TFRecord files through ``TFRecordIterator`` and the
+``ParseExample`` op (``utils/tf/Session.scala:150``,
+``ops/ParseExample.scala``), with generated protobuf classes.  Here the
+record framing (length + masked CRC32C, shared with the TensorBoard
+writer) and the tiny subset of proto wire format that ``Example``
+needs are decoded directly — no protobuf runtime dependency.
+
+Wire format decoded::
+
+    Example      := features(field 1: message Features)
+    Features     := feature(field 1: map<string, Feature>)
+    map entry    := key(field 1: string) value(field 2: message Feature)
+    Feature      := one of bytes_list(1) / float_list(2) / int64_list(3)
+    BytesList    := value(field 1: repeated bytes)
+    FloatList    := value(field 1: repeated float, packed or not)
+    Int64List    := value(field 1: repeated varint, packed or not)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Union
+
+import numpy as np
+
+__all__ = ["TFRecordIterator", "parse_example", "write_tfrecord"]
+
+
+def TFRecordIterator(path: str, check_crc: bool = True) -> Iterator[bytes]:
+    """Yield raw records from a TFRecord file (``TFRecordIterator`` in the
+    reference's ``utils/tf``)."""
+    from bigdl_tpu import native
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if check_crc and native.masked_crc32c(header) != hcrc:
+                raise IOError(f"corrupt TFRecord header in {path}")
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if check_crc and native.masked_crc32c(data) != dcrc:
+                raise IOError(f"corrupt TFRecord data in {path}")
+            yield data
+
+
+def write_tfrecord(path: str, records) -> None:
+    """Write records with TFRecord framing (for tests/interop fixtures)."""
+    from bigdl_tpu.visualization.tensorboard import RecordWriter
+
+    with open(path, "wb") as f:
+        w = RecordWriter(f)
+        for rec in records:
+            w.write(rec)
+
+
+# ---------------------------------------------------------------------------
+# proto wire-format decoding (just enough for Example)
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message buffer."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:  # varint
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:  # 64-bit
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:  # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:  # 32-bit
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _parse_feature(buf: bytes) -> Union[List[bytes], np.ndarray]:
+    for field, wt, val in _fields(buf):
+        if field == 1:  # BytesList
+            return [v for f, _, v in _fields(val) if f == 1]
+        if field == 2:  # FloatList
+            floats: List[float] = []
+            for f, w, v in _fields(val):
+                if f != 1:
+                    continue
+                if w == 2:  # packed
+                    floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+                else:
+                    floats.append(struct.unpack("<f", v)[0])
+            return np.asarray(floats, np.float32)
+        if field == 3:  # Int64List
+            ints: List[int] = []
+            for f, w, v in _fields(val):
+                if f != 1:
+                    continue
+                if w == 2:  # packed varints
+                    p = 0
+                    while p < len(v):
+                        x, p = _read_varint(v, p)
+                        ints.append(x)
+                else:
+                    ints.append(v)
+            # varints are unsigned on the wire; fold back to signed int64
+            ints = [x - (1 << 64) if x >= (1 << 63) else x for x in ints]
+            return np.asarray(ints, np.int64)
+    return np.asarray([], np.float32)
+
+
+def parse_example(serialized: bytes) -> Dict[str, Union[List[bytes],
+                                                        np.ndarray]]:
+    """Decode a serialized TF Example into {name: bytes-list or ndarray}."""
+    features: Dict[str, Union[List[bytes], np.ndarray]] = {}
+    for field, _, val in _fields(serialized):
+        if field != 1:  # Features
+            continue
+        for f2, _, entry in _fields(val):
+            if f2 != 1:  # map<string, Feature>
+                continue
+            key = None
+            feat = None
+            for f3, _, v3 in _fields(entry):
+                if f3 == 1:
+                    key = v3.decode("utf-8")
+                elif f3 == 2:
+                    feat = v3
+            if key is not None and feat is not None:
+                features[key] = _parse_feature(feat)
+    return features

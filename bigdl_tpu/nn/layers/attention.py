@@ -101,9 +101,14 @@ class MultiHeadAttention(Module):
                     "custom attention backends do not accept masks; bake "
                     "masking into the callable")
             return backend(q, k, v)
+        if backend == "flash" and mask is not None:
+            raise ValueError(
+                "backend='flash' does not support masks (only causal=True); "
+                "use backend='dense' or 'auto' for masked attention")
         if backend == "auto":
-            backend = "flash" if jax.default_backend() == "tpu" else "dense"
-        if backend == "flash" and mask is None:
+            backend = "flash" if (jax.default_backend() == "tpu"
+                                  and mask is None) else "dense"
+        if backend == "flash":
             return flash_attention(q, k, v, causal=self.causal)
         return dot_product_attention(q, k, v, mask=mask, causal=self.causal)
 

@@ -1,0 +1,468 @@
+"""TF-style forward-only operations (the reference's ``nn/ops/``
+subpackage, 28 files — SURVEY §2.5): ``Operation`` base plus the op
+catalog, re-expressed on jax/lax.
+
+Ops are ``Module``s whose backward is forbidden (``ops/Operation.scala``
+throws on backward); they exist for graph-import parity and for building
+TF-flavored compute graphs with the ``Graph`` API.  Control flow
+(``ops/ControlOps.scala``) maps to structured XLA primitives via
+``bigdl_tpu.ops.control`` — under XLA both branches of a Switch/Merge
+pair are traced and the result selected, rather than one branch being
+skipped by a scheduler; results are identical, only the cost model
+differs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+__all__ = [
+    "Operation", "ModuleToOperation",
+    "Conv2D", "MaxPool", "AvgPool", "BiasAdd", "Cast",
+    "Equal", "NotEqual", "Greater", "GreaterEqual", "Less", "LessEqual",
+    "LogicalAnd", "LogicalOr", "LogicalNot",
+    "Floor", "Ceil", "Round", "L2Loss", "OneHot", "Pad", "Prod",
+    "RandomUniform", "TruncatedNormal", "Rank", "ResizeBilinearOps",
+    "Slice", "Assign", "Assert", "DecodeImage", "ParseExample",
+    "While", "Cond", "Switch", "Merge", "Select",
+]
+
+
+class Operation(Module):
+    """Forward-only module (``ops/Operation.scala``): backward raises."""
+
+    def backward(self, input, grad_output):  # noqa: D401
+        raise RuntimeError(
+            f"Operation {type(self).__name__} does not support backward")
+
+    def update_grad_input(self, input, grad_output):
+        raise RuntimeError(
+            f"Operation {type(self).__name__} does not support backward")
+
+
+class ModuleToOperation(Operation):
+    """Wrap any module as a forward-only op (``ops/ModuleToOperation.scala``)."""
+
+    def __init__(self, module: Module):
+        super().__init__()
+        self.module = module
+
+    def update_output(self, input):
+        return self.module.forward(input)
+
+
+# ---------------------------------------------------------------------------
+# compute ops
+# ---------------------------------------------------------------------------
+
+class Conv2D(Operation):
+    """TF-semantics conv over (input, filter) pair (``ops/Conv2D.scala``).
+    input NHWC (or NCHW), filter [kh, kw, cin, cout]."""
+
+    def __init__(self, stride_h: int = 1, stride_w: int = 1,
+                 padding: str = "SAME", format: str = "NHWC"):
+        super().__init__()
+        self.strides = (stride_h, stride_w)
+        self.padding = padding
+        self.format = format
+
+    def update_output(self, input):
+        x, w = input
+        dn = lax.conv_dimension_numbers(
+            x.shape, w.shape,
+            (self.format, "HWIO", self.format))
+        return lax.conv_general_dilated(
+            x, w, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=dn)
+
+
+class _PoolOp(Operation):
+    def __init__(self, ksize, strides, padding: str = "VALID",
+                 format: str = "NHWC"):
+        super().__init__()
+        self.ksize = tuple(ksize)
+        self.strides = tuple(strides)
+        self.padding = padding
+        self.format = format
+
+    def _window(self):
+        if self.format == "NHWC":
+            return (1, *self.ksize, 1), (1, *self.strides, 1)
+        return (1, 1, *self.ksize), (1, 1, *self.strides)
+
+
+class MaxPool(_PoolOp):
+    """``ops/MaxPool.scala``."""
+
+    def update_output(self, input):
+        win, strides = self._window()
+        return lax.reduce_window(input, -jnp.inf, lax.max, win, strides,
+                                 self.padding)
+
+
+class AvgPool(_PoolOp):
+    """TF AvgPool (``utils/tf/loaders/AvgPool.scala``)."""
+
+    def update_output(self, input):
+        win, strides = self._window()
+        s = lax.reduce_window(input, 0.0, lax.add, win, strides, self.padding)
+        ones = jnp.ones_like(input)
+        count = lax.reduce_window(ones, 0.0, lax.add, win, strides,
+                                  self.padding)
+        return s / count
+
+
+class BiasAdd(Operation):
+    """(value, bias) -> value + bias over the channel dim
+    (``ops/BiasAdd.scala``)."""
+
+    def __init__(self, format: str = "NHWC"):
+        super().__init__()
+        self.format = format
+
+    def update_output(self, input):
+        x, b = input
+        if self.format == "NCHW" and x.ndim > 2:
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            return x + b.reshape(shape)
+        return x + b
+
+
+class Cast(Operation):
+    """``ops/Cast.scala``."""
+
+    def __init__(self, dtype):
+        super().__init__()
+        self.dtype = dtype
+
+    def update_output(self, input):
+        return jnp.asarray(input).astype(self.dtype)
+
+
+def _binary(name, fn, doc):
+    def update_output(self, input):
+        a, b = input
+        return fn(jnp.asarray(a), jnp.asarray(b))
+
+    return type(name, (Operation,), {
+        "update_output": update_output, "__doc__": doc})
+
+
+Equal = _binary("Equal", lambda a, b: a == b, "``ops/Equal.scala``.")
+NotEqual = _binary("NotEqual", lambda a, b: a != b, "``ops/NotEqual.scala``.")
+Greater = _binary("Greater", lambda a, b: a > b, "``ops/Greater.scala``.")
+GreaterEqual = _binary("GreaterEqual", lambda a, b: a >= b,
+                       "TF GreaterEqual.")
+Less = _binary("Less", lambda a, b: a < b, "``ops/Less.scala``.")
+LessEqual = _binary("LessEqual", lambda a, b: a <= b, "TF LessEqual.")
+LogicalAnd = _binary("LogicalAnd", jnp.logical_and,
+                     "``ops/LogicalAnd.scala``.")
+LogicalOr = _binary("LogicalOr", jnp.logical_or, "``ops/LogicalOr.scala``.")
+
+
+class LogicalNot(Operation):
+    """``ops/LogicalNot.scala``."""
+
+    def update_output(self, input):
+        return jnp.logical_not(input)
+
+
+class Floor(Operation):
+    """``ops/Floor.scala``."""
+
+    def update_output(self, input):
+        return jnp.floor(input)
+
+
+class Ceil(Operation):
+    def update_output(self, input):
+        return jnp.ceil(input)
+
+
+class Round(Operation):
+    def update_output(self, input):
+        return jnp.round(input)
+
+
+class L2Loss(Operation):
+    """sum(x^2) / 2 (``ops/L2Loss.scala``)."""
+
+    def update_output(self, input):
+        x = input.astype(jnp.float32)
+        return jnp.sum(x * x) / 2
+
+
+class OneHot(Operation):
+    """(indices, depth, on_value, off_value) -> one-hot along ``axis``
+    (``ops/OneHot.scala``); depth/on/off may be fixed at construction."""
+
+    def __init__(self, axis: int = -1, depth: Optional[int] = None,
+                 on_value=1.0, off_value=0.0):
+        super().__init__()
+        self.axis = axis
+        self.depth = depth
+        self.on_value = on_value
+        self.off_value = off_value
+
+    def update_output(self, input):
+        if isinstance(input, (tuple, list)):
+            indices, depth, on, off = (list(input) + [self.depth,
+                                                      self.on_value,
+                                                      self.off_value])[:4]
+            depth = int(depth)
+        else:
+            indices, depth, on, off = (input, self.depth, self.on_value,
+                                       self.off_value)
+        oh = jax.nn.one_hot(jnp.asarray(indices), depth, axis=self.axis)
+        return oh * on + (1 - oh) * off
+
+
+class Pad(Operation):
+    """Constant-pad with static [n, 2] paddings (``ops/Pad.scala``)."""
+
+    def __init__(self, paddings, constant_value=0):
+        super().__init__()
+        self.paddings = [tuple(int(v) for v in row) for row in
+                         np.asarray(paddings)]
+        self.constant_value = constant_value
+
+    def update_output(self, input):
+        return jnp.pad(input, self.paddings, mode="constant",
+                       constant_values=self.constant_value)
+
+
+class Prod(Operation):
+    """Reduce-product along a dim (``ops/Prod.scala``)."""
+
+    def __init__(self, axis: Optional[int] = None, keep_dims: bool = False):
+        super().__init__()
+        self.axis = axis
+        self.keep_dims = keep_dims
+
+    def update_output(self, input):
+        return jnp.prod(input, axis=self.axis, keepdims=self.keep_dims)
+
+
+class RandomUniform(Operation):
+    """Uniform [min, max) of the given shape (``ops/RandomUniform.scala``).
+    WithoutInput node: generates from its static shape."""
+
+    _without_input = True
+
+    def __init__(self, shape, min_val: float = 0.0, max_val: float = 1.0,
+                 dtype=jnp.float32):
+        super().__init__()
+        from bigdl_tpu.utils.rng import next_rng_id
+
+        self.shape = tuple(shape)
+        self.min_val, self.max_val = min_val, max_val
+        self.dtype = dtype
+        self._rng_id = next_rng_id()
+
+    def update_output(self, input):
+        from bigdl_tpu.utils.rng import require_rng
+
+        key = require_rng(self._rng_id)
+        return jax.random.uniform(key, self.shape, self.dtype,
+                                  self.min_val, self.max_val)
+
+
+class TruncatedNormal(Operation):
+    """Normal(0, std) truncated to 2 sigma (``ops/TruncatedNormal.scala``)."""
+
+    _without_input = True
+
+    def __init__(self, shape, mean: float = 0.0, stddev: float = 1.0,
+                 dtype=jnp.float32):
+        super().__init__()
+        from bigdl_tpu.utils.rng import next_rng_id
+
+        self.shape = tuple(shape)
+        self.mean, self.stddev = mean, stddev
+        self.dtype = dtype
+        self._rng_id = next_rng_id()
+
+    def update_output(self, input):
+        from bigdl_tpu.utils.rng import require_rng
+
+        key = require_rng(self._rng_id)
+        z = jax.random.truncated_normal(key, -2.0, 2.0, self.shape,
+                                        self.dtype)
+        return z * self.stddev + self.mean
+
+
+class Rank(Operation):
+    """ndim as a scalar tensor (``ops/Rank.scala``)."""
+
+    def update_output(self, input):
+        return jnp.asarray(jnp.ndim(input), jnp.int32)
+
+
+class ResizeBilinearOps(Operation):
+    """(images NHWC, size) -> bilinear resize (``ops/ResizeBilinearOps.scala``)."""
+
+    def __init__(self, align_corners: bool = False):
+        super().__init__()
+        self.align_corners = align_corners
+
+    def update_output(self, input):
+        images, size = input
+        h, w = int(size[0]), int(size[1])
+        shape = images.shape[:-3] + (h, w, images.shape[-1])
+        return jax.image.resize(images, shape, method="bilinear")
+
+
+class Slice(Operation):
+    """Static begin/size slice (``ops/Slice.scala``)."""
+
+    def __init__(self, begin: Sequence[int], size: Sequence[int]):
+        super().__init__()
+        self.begin = tuple(begin)
+        self.size = tuple(size)
+
+    def update_output(self, input):
+        sizes = tuple(input.shape[i] - b if s == -1 else s
+                      for i, (b, s) in enumerate(zip(self.begin, self.size)))
+        return lax.dynamic_slice(input, self.begin, sizes)
+
+
+class Assign(Operation):
+    """Host-side variable write: stores the incoming value in a buffer and
+    returns it (``ops/Assign.scala``).  Mutation happens eagerly on the
+    module object; inside jit the op is a passthrough."""
+
+    def update_output(self, input):
+        ref, value = input if isinstance(input, (tuple, list)) else (None, input)
+        self.__dict__["value"] = value
+        return value
+
+
+class Assert(Operation):
+    """Eager-mode assertion (``ops/Assert.scala``): checks the predicate
+    when running outside a trace; a passthrough no-op under jit."""
+
+    def update_output(self, input):
+        pred, data = input
+        if not isinstance(pred, jax.core.Tracer):
+            if not bool(jnp.all(jnp.asarray(pred))):
+                raise AssertionError(f"Assert failed: {data}")
+        return data
+
+
+class DecodeImage(Operation):
+    """Decode JPEG/PNG bytes to an HWC uint8 array (``ops/DecodeImage.scala``);
+    host-side (not jittable)."""
+
+    def __init__(self, channels: int = 3):
+        super().__init__()
+        self.channels = channels
+
+    def update_output(self, input):
+        import io
+
+        from PIL import Image
+
+        mode = {1: "L", 3: "RGB", 4: "RGBA"}[self.channels]
+        arr = np.asarray(Image.open(io.BytesIO(bytes(input))).convert(mode))
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return jnp.asarray(arr)
+
+
+class ParseExample(Operation):
+    """Parse serialized TF Example protos into dense tensors
+    (``ops/ParseExample.scala``); host-side, backed by the minimal proto
+    reader in ``bigdl_tpu.dataset.tfrecord``."""
+
+    def __init__(self, keys: Sequence[str], dtypes: Sequence,
+                 shapes: Sequence):
+        super().__init__()
+        self.keys = list(keys)
+        self.dtypes = list(dtypes)
+        self.shapes = [tuple(s) for s in shapes]
+
+    def update_output(self, input):
+        from bigdl_tpu.dataset.tfrecord import parse_example
+
+        records = input if isinstance(input, (tuple, list)) else [input]
+        cols = {k: [] for k in self.keys}
+        for rec in records:
+            feats = parse_example(bytes(rec))
+            for k in self.keys:
+                cols[k].append(feats[k])
+        outs = []
+        for k, dt, shape in zip(self.keys, self.dtypes, self.shapes):
+            arr = np.asarray(cols[k], dtype=dt).reshape((len(records),) + shape)
+            outs.append(jnp.asarray(arr))
+        return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# control flow
+# ---------------------------------------------------------------------------
+
+class While(Operation):
+    """Structured while-loop (``ops/ControlOps.scala`` WhileOps →
+    ``lax.while_loop``).  Input = initial loop vars."""
+
+    def __init__(self, cond_module: Module, body_module: Module):
+        super().__init__()
+        self.cond_module = cond_module
+        self.body_module = body_module
+
+    def update_output(self, input):
+        from bigdl_tpu.ops.control import while_modules
+
+        return while_modules(self.cond_module, self.body_module, input)
+
+
+class Cond(Operation):
+    """Structured two-way branch: input = (pred, operand) →
+    ``lax.cond`` over the two modules."""
+
+    def __init__(self, true_module: Module, false_module: Module):
+        super().__init__()
+        self.true_module = true_module
+        self.false_module = false_module
+
+    def update_output(self, input):
+        from bigdl_tpu.ops.control import cond_modules
+
+        pred, operand = input
+        return cond_modules(pred, self.true_module, self.false_module,
+                            operand)
+
+
+class Switch(Operation):
+    """(data, pred) -> (false_branch, true_branch) pair.  Under XLA both
+    downstream branches are traced; pair with ``Merge`` which selects by
+    the same predicate (``ops/ControlOps.scala`` SwitchOps)."""
+
+    def update_output(self, input):
+        data, pred = input
+        return (data, data, jnp.asarray(pred))
+
+
+class Merge(Operation):
+    """Select between two branch results by predicate: input =
+    (false_out, true_out, pred) (``ops/ControlOps.scala`` MergeOps)."""
+
+    def update_output(self, input):
+        f_out, t_out, pred = input
+        p = jnp.reshape(jnp.asarray(pred), ()).astype(bool)
+        return jax.tree.map(lambda a, b: jnp.where(p, b, a), f_out, t_out)
+
+
+class Select(Operation):
+    """Elementwise where(condition, t, e) (``ops/Select.scala``-like)."""
+
+    def update_output(self, input):
+        c, t, e = input
+        return jnp.where(c, t, e)

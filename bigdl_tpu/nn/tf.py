@@ -1,0 +1,100 @@
+"""TF-support layers (the reference's ``nn/tf/`` subpackage, 7 files —
+SURVEY §2.5): Const, Fill, Shape, SplitAndSelect, StrideSlice, Variable,
+ControlDependency, plus the WithoutInput marker semantics used by Graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = ["Const", "Fill", "Shape", "SplitAndSelect", "StrideSlice",
+           "Variable", "ControlDependency"]
+
+
+class Const(Module):
+    """Constant-emitting node (``nn/tf/Const.scala``); takes no input."""
+
+    _without_input = True
+    _is_const = True
+
+    def __init__(self, value):
+        super().__init__()
+        self.value = jnp.asarray(value)
+
+    def update_output(self, input):
+        return self.value
+
+
+class Fill(Module):
+    """(shape, value) -> full tensor (``nn/tf/Fill.scala``); shape must be
+    static (host values, not traced)."""
+
+    def update_output(self, input):
+        shape, value = input
+        shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+        return jnp.full(shape, value)
+
+
+class Shape(Module):
+    """Tensor shape as a 1-D int32 tensor (``nn/tf/Shape.scala``)."""
+
+    def update_output(self, input):
+        return jnp.asarray(jnp.shape(input), jnp.int32)
+
+
+class SplitAndSelect(Module):
+    """Split along ``dim`` into ``num_splits`` and return chunk ``index``
+    (``nn/tf/SplitAndSelect.scala``)."""
+
+    def __init__(self, dim: int, index: int, num_splits: int):
+        super().__init__()
+        self.dim, self.index, self.num_splits = dim, index, num_splits
+
+    def update_output(self, input):
+        return jnp.split(input, self.num_splits, axis=self.dim)[self.index]
+
+
+class StrideSlice(Module):
+    """Python-semantics strided slice; specs = [(dim, start, stop, step)]
+    (``nn/tf/StrideSlice.scala``)."""
+
+    def __init__(self, specs: Sequence[Tuple[int, int, int, int]]):
+        super().__init__()
+        self.specs = [tuple(s) for s in specs]
+
+    def update_output(self, input):
+        slices = [slice(None)] * input.ndim
+        for dim, start, stop, step in self.specs:
+            slices[dim] = slice(start, stop, step)
+        return input[tuple(slices)]
+
+
+class Variable(Module):
+    """Trainable tensor node (``nn/tf/Variable.scala``): emits its weight;
+    gradients flow into it like any parameter."""
+
+    _without_input = True
+
+    def __init__(self, initial_value):
+        super().__init__()
+        self.weight = Parameter(initial_value)
+
+    def update_output(self, input):
+        return self._params["weight"]
+
+
+class ControlDependency(Module):
+    """Ordering-only edge: forwards its first input, ignores the rest
+    (``nn/tf/ControlDependency.scala``).  Under XLA ordering is handled by
+    data dependence, so this is a passthrough."""
+
+    def update_output(self, input):
+        if isinstance(input, (tuple, list)):
+            return input[0]
+        return input

@@ -56,6 +56,10 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False,
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows output 0 (matching the flash/ring convention)
+    # instead of softmax's uniform distribution over masked positions
+    valid = jnp.max(s, axis=-1, keepdims=True) > _NEG_INF / 2
+    p = jnp.where(valid, p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
@@ -98,137 +102,171 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale: float, causal: bool, block_k: int, kv_len: int):
-    from jax.experimental import pallas as pl  # noqa: F401
+# Grid layout: (batch*heads, q_blocks, k_blocks) for fwd/dq and
+# (batch*heads, k_blocks, q_blocks) for dkv.  The innermost grid dimension
+# iterates sequentially on-core, so only one (block, d) tile of each
+# operand is VMEM-resident at a time (k/v stream from HBM block-by-block)
+# while the running online-softmax state lives in VMEM scratch — this is
+# what keeps the kernel O(block) in VMEM at arbitrary sequence length.
+# m/l scratch is broadcast over 128 lanes to satisfy TPU tiling.
 
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    q_start = pl.program_id(1) * block_q
-    nk = pl.cdiv(kv_len, block_k)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_len = block_q * pl.num_programs(1)
-            off = kv_len - q_len
-            q_pos = q_start + off + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    if causal:
-        q_len = block_q * pl.num_programs(1)
-        off = kv_len - q_len
-        hi = lax.min(nk, (q_start + off + block_q - 1) // block_k + 1)
-    else:
-        hi = nk
-    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :, 0] = m + jnp.log(l_safe)
+_LANES = 128
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale: float, causal: bool, block_k: int, kv_len: int):
+def _causal_bounds(block_q, block_k, q_len, kv_len):
+    """off such that q row i attends k positions <= i + off."""
+    return kv_len - q_len
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_s, m_s, l_s, *,
+                scale: float, causal: bool, q_len: int, kv_len: int):
     from jax.experimental import pallas as pl
 
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
-    q_start = pl.program_id(1) * block_q
-    nk = pl.cdiv(kv_len, block_k)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+    off = _causal_bounds(block_q, block_k, q_len, kv_len)
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    live = True
+    if causal:
+        live = q_start + off + block_q - 1 >= k_start
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_len = block_q * pl.num_programs(1)
-            off = kv_len - q_len
             q_pos = q_start + off + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + lax.broadcasted_iota(
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_s[:, 0]
+        l_prev = l_s[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_new[:, None]), 0.0)
+        m_s[...] = jnp.broadcast_to(m_new[:, None], m_s.shape)
+        l_s[...] = jnp.broadcast_to(
+            (l_prev * alpha + jnp.sum(p, axis=-1))[:, None], l_s.shape)
+        acc_s[...] = acc_s[...] * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_s[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_s[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m_s[:, 0] + jnp.log(l_safe)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_s, *, scale: float, causal: bool, q_len: int, kv_len: int):
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+    off = _causal_bounds(block_q, block_k, q_len, kv_len)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    live = True
+    if causal:
+        live = q_start + off + block_q - 1 >= k_start
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dq_s[...] = dq_s[...] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
 
-    if causal:
-        q_len = block_q * pl.num_programs(1)
-        off = kv_len - q_len
-        hi = lax.min(nk, (q_start + off + block_q - 1) // block_k + 1)
-    else:
-        hi = nk
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale: float, causal: bool,
-                block_q: int, q_len: int):
+                dk_ref, dv_ref, dk_s, dv_s, *,
+                scale: float, causal: bool, q_len: int, kv_len: int):
     from jax.experimental import pallas as pl
 
-    block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    k_start = pl.program_id(1) * block_k
-    kv_len = block_k * pl.num_programs(1)
-    nq = pl.cdiv(q_len, block_q)
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    block_q = q_ref.shape[1]
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+    off = _causal_bounds(block_q, block_k, q_len, kv_len)
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    live = True
+    if causal:
+        live = q_start + off + block_q - 1 >= k_start
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0, :, 0]
+        delta_blk = delta_ref[0, :, 0]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            off = kv_len - q_len
-            q_pos = i * block_q + off + lax.broadcasted_iota(
+            q_pos = q_start + off + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - lse_blk[:, None]), 0.0)
-        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dv_s[...] = dv_s[...] + jnp.dot(
+            p.T, do_blk, preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk[:, None]) * scale
-        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        dk_s[...] = dk_s[...] + jnp.dot(
+            ds.T, q_blk, preferred_element_type=jnp.float32)
 
-    if causal:
-        off = kv_len - q_len
-        lo = lax.max(0, (k_start - off) // block_q)
-    else:
-        lo = 0
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(lo, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _pick_block(s: int, pref: int) -> int:
@@ -242,6 +280,7 @@ def _pick_block(s: int, pref: int) -> int:
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -250,24 +289,29 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
-    grid = (b * h, sq // bq)
+    grid = (b * h, sq // bq, sk // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=bk, kv_len=sk)
+                               q_len=sq, kv_len=sk)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -277,6 +321,7 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
 def _flash_bwd_impl(q, k, v, out, lse, do, scale, causal,
                     block_q, block_k, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -292,40 +337,45 @@ def _flash_bwd_impl(q, k, v, out, lse, do, scale, causal,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=bk, kv_len=sk),
-        grid=(b * h, sq // bq),
+                          q_len=sq, kv_len=sk),
+        grid=(b * h, sq // bq, sk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, q_len=sq),
-        grid=(b * h, sk // bk),
+                          q_len=sq, kv_len=sk),
+        grid=(b * h, sk // bk, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, sq, 1), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, sq, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
@@ -376,3 +426,4 @@ def flash_attention(q, k, v, causal: bool = False,
         # shapes the Mosaic tiling can't express — dense fallback
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+

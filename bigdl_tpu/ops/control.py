@@ -1,0 +1,51 @@
+"""Control-flow combinators: the TPU-native mapping of the reference's
+ControlOps/Scheduler cycles (``nn/ops/ControlOps.scala``,
+``nn/Scheduler.scala:41``).
+
+The reference executes while-loops by re-enqueuing graph nodes in a
+ready-queue scheduler.  Under XLA everything is traced once and compiled,
+so loops/branches must be structured primitives: ``while_modules`` lowers
+to ``jax.lax.while_loop`` and ``cond_modules`` to ``jax.lax.cond``.  The
+nn-level ``While``/``Cond``/``Switch``/``Merge`` layers
+(``bigdl_tpu.nn.ops``) wrap these.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["while_modules", "cond_modules"]
+
+
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (list, tuple)) else (x,)
+
+
+def while_modules(cond_module, body_module, init_vars):
+    """Run ``body_module`` on the loop-variable table while ``cond_module``
+    returns true.  Both receive the loop vars (a single array or a tuple);
+    cond must produce a scalar boolean."""
+    init = _as_tuple(init_vars)
+    multi = isinstance(init_vars, (list, tuple))
+
+    def cond_fn(vs):
+        out = cond_module.forward(vs if multi else vs[0])
+        return jnp.reshape(jnp.asarray(out), ()).astype(bool)
+
+    def body_fn(vs):
+        out = body_module.forward(vs if multi else vs[0])
+        return _as_tuple(out)
+
+    final = lax.while_loop(cond_fn, body_fn, init)
+    return final if multi else final[0]
+
+
+def cond_modules(pred, true_module, false_module, operand):
+    """``lax.cond`` over two modules sharing one operand."""
+    p = jnp.reshape(jnp.asarray(pred), ()).astype(bool)
+    return lax.cond(p, lambda x: true_module.forward(x),
+                    lambda x: false_module.forward(x), operand)

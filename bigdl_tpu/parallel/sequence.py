@@ -68,15 +68,24 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
              jnp.zeros((b, h, sq), jnp.float32))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    neutral = state
     for step in range(n):
         src = (idx - step) % n
         if causal:
+            # branch by chunk position so fully-future chunks cost nothing
+            # and fully-past chunks skip the mask: 0 = skip (src > idx),
+            # 1 = diagonal triangle (src == idx), 2 = unmasked (src < idx)
             k_pos = src * s_local + jnp.arange(s_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            mask = mask[None, None]
+            tri_mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            case = jnp.where(src < idx, 2, jnp.where(src == idx, 1, 0))
+            part = lax.switch(case, [
+                lambda kv: neutral,
+                lambda kv: attention_partial(q, kv[0], kv[1], scale,
+                                             mask=tri_mask),
+                lambda kv: attention_partial(q, kv[0], kv[1], scale),
+            ], (k, v))
         else:
-            mask = None
-        part = attention_partial(q, k, v, scale, mask=mask)
+            part = attention_partial(q, k, v, scale)
         state = combine_partials(state, part)
         if step != n - 1:
             k, v = lax.ppermute((k, v), axis_name, perm)

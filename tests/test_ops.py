@@ -1,0 +1,209 @@
+"""TF-style op catalog (nn.ops), TF-support layers (nn.tf), control flow,
+and the TFRecord/Example reader."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn import ops, tf
+
+
+def test_conv2d_biasadd_maxpool():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 8, 3), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 3, 4), jnp.float32)
+    b = jnp.asarray(rng.randn(4), jnp.float32)
+    y = ops.Conv2D(padding="SAME").forward((x, w))
+    assert y.shape == (2, 8, 8, 4)
+    y = ops.BiasAdd().forward((y, b))
+    p = ops.MaxPool((2, 2), (2, 2)).forward(y)
+    assert p.shape == (2, 4, 4, 4)
+    a = ops.AvgPool((2, 2), (2, 2)).forward(y)
+    np.testing.assert_allclose(
+        np.asarray(a),
+        np.asarray(y).reshape(2, 4, 2, 4, 2, 4).mean(axis=(2, 4)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_comparison_and_logical_ops():
+    a = jnp.asarray([1.0, 2.0, 3.0])
+    b = jnp.asarray([2.0, 2.0, 2.0])
+    assert np.array_equal(np.asarray(ops.Equal().forward((a, b))),
+                          [False, True, False])
+    assert np.array_equal(np.asarray(ops.Greater().forward((a, b))),
+                          [False, False, True])
+    assert np.array_equal(np.asarray(ops.Less().forward((a, b))),
+                          [True, False, False])
+    t = jnp.asarray([True, False, True])
+    f = jnp.asarray([True, True, False])
+    assert np.array_equal(np.asarray(ops.LogicalAnd().forward((t, f))),
+                          [True, False, False])
+    assert np.array_equal(np.asarray(ops.LogicalOr().forward((t, f))),
+                          [True, True, True])
+    assert np.array_equal(np.asarray(ops.LogicalNot().forward(t)),
+                          [False, True, False])
+
+
+def test_elementwise_and_reduction_ops():
+    x = jnp.asarray([[1.7, -2.3], [0.5, 4.0]])
+    np.testing.assert_array_equal(np.asarray(ops.Floor().forward(x)),
+                                  np.floor(np.asarray(x)))
+    assert float(ops.L2Loss().forward(x)) == pytest.approx(
+        float(np.sum(np.asarray(x) ** 2) / 2))
+    np.testing.assert_allclose(
+        np.asarray(ops.Prod(axis=1).forward(x)),
+        np.prod(np.asarray(x), axis=1), rtol=1e-6)
+    oh = ops.OneHot(depth=4).forward(jnp.asarray([0, 2]))
+    np.testing.assert_array_equal(np.asarray(oh),
+                                  [[1, 0, 0, 0], [0, 0, 1, 0]])
+    assert int(ops.Rank().forward(x)) == 2
+    assert ops.Cast(jnp.int32).forward(x).dtype == jnp.int32
+
+
+def test_pad_slice_resize():
+    x = jnp.arange(12.0).reshape(3, 4)
+    p = ops.Pad([[1, 1], [0, 2]]).forward(x)
+    assert p.shape == (5, 6)
+    s = ops.Slice((1, 0), (2, -1)).forward(x)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(x)[1:3, :])
+    img = jnp.ones((1, 4, 4, 3))
+    r = ops.ResizeBilinearOps().forward((img, (8, 8)))
+    assert r.shape == (1, 8, 8, 3)
+
+
+def test_random_ops_and_rng_determinism():
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(7)
+    u1 = ops.RandomUniform((64,), 2.0, 5.0).forward(None)
+    assert float(jnp.min(u1)) >= 2.0 and float(jnp.max(u1)) < 5.0
+    tn = ops.TruncatedNormal((512,), stddev=2.0).forward(None)
+    assert float(jnp.max(jnp.abs(tn))) <= 4.0 + 1e-5
+
+
+def test_operation_backward_raises():
+    with pytest.raises(RuntimeError):
+        ops.Floor().backward(jnp.ones(3), jnp.ones(3))
+
+
+def test_while_loop_lowering():
+    class CondM(nn.Module):
+        def update_output(self, vs):
+            i, acc = vs
+            return i < 5
+
+    class BodyM(nn.Module):
+        def update_output(self, vs):
+            i, acc = vs
+            return (i + 1, acc * 2.0)
+
+    w = ops.While(CondM(), BodyM())
+    i, acc = w.forward((jnp.asarray(0), jnp.asarray(1.0)))
+    assert int(i) == 5 and float(acc) == 32.0
+    # must also compile under jit
+    i2, acc2 = jax.jit(lambda v: w.forward(v))((jnp.asarray(0),
+                                                jnp.asarray(1.0)))
+    assert int(i2) == 5 and float(acc2) == 32.0
+
+
+def test_cond_switch_merge():
+    double = nn.MulConstant(2.0)
+    halve = nn.MulConstant(0.5)
+    c = ops.Cond(double, halve)
+    assert float(c.forward((jnp.asarray(True), jnp.asarray(3.0)))) == 6.0
+    assert float(c.forward((jnp.asarray(False), jnp.asarray(3.0)))) == 1.5
+
+    x = jnp.asarray([1.0, 2.0])
+    f_out, t_out, pred = ops.Switch().forward((x, jnp.asarray(True)))
+    merged = ops.Merge().forward((f_out * 0.5, t_out * 2.0, pred))
+    np.testing.assert_allclose(np.asarray(merged), [2.0, 4.0])
+
+
+def test_tf_support_layers_in_graph():
+    inp = nn.Input()
+    const = nn.Node(tf.Const(jnp.asarray([10.0, 20.0])))
+    add = nn.CAddTable()
+    out = nn.graph.node_from_module(add, [inp, const])
+    g = nn.Graph(inp, out)
+    y = g.forward(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(y), [11.0, 22.0])
+
+
+def test_tf_variable_trains():
+    from bigdl_tpu.nn.module import functional_call, state_dict
+
+    v = tf.Variable(jnp.zeros((3,)))
+    params = state_dict(v, kind="param")
+
+    def loss(p):
+        out, _ = functional_call(v, p, None)
+        return jnp.sum((out - 2.0) ** 2)
+
+    g = jax.grad(loss)(params)
+    np.testing.assert_allclose(np.asarray(list(g.values())[0]),
+                               [-4.0, -4.0, -4.0])
+
+
+def test_tf_shape_fill_slice_layers():
+    x = jnp.ones((2, 3, 4))
+    np.testing.assert_array_equal(np.asarray(tf.Shape().forward(x)),
+                                  [2, 3, 4])
+    f = tf.Fill().forward(((2, 2), 7.0))
+    np.testing.assert_array_equal(np.asarray(f), [[7.0, 7.0], [7.0, 7.0]])
+    s = tf.SplitAndSelect(1, 1, 3).forward(x)
+    assert s.shape == (2, 1, 4)
+    st = tf.StrideSlice([(0, 0, 2, 1), (1, 0, 3, 2)]).forward(x)
+    assert st.shape == (2, 2, 4)
+    cd = tf.ControlDependency().forward((x, jnp.zeros(1)))
+    assert cd.shape == x.shape
+
+
+def test_tfrecord_roundtrip_and_parse_example():
+    import struct
+    import tempfile
+
+    from bigdl_tpu.dataset.tfrecord import (TFRecordIterator, parse_example,
+                                            write_tfrecord)
+
+    # hand-encode an Example proto: {"x": float_list [1.5, -2.5],
+    #                                "y": int64_list [3], "s": bytes "ab"}
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(field, payload):
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    float_list = ld(1, struct.pack("<2f", 1.5, -2.5))  # packed
+    feat_x = ld(2, float_list)
+    int_list = ld(1, varint(3))
+    feat_y = ld(3, int_list)
+    bytes_list = ld(1, b"ab")  # BytesList{value: "ab"}
+    feat_s = ld(1, bytes_list)  # Feature{bytes_list: ...}
+    entry_x = ld(1, b"x") + ld(2, feat_x)
+    entry_y = ld(1, b"y") + ld(2, feat_y)
+    entry_s = ld(1, b"s") + ld(2, feat_s)
+    features = ld(1, entry_x) + ld(1, entry_y) + ld(1, entry_s)
+    example = ld(1, features)
+
+    feats = parse_example(example)
+    np.testing.assert_allclose(feats["x"], [1.5, -2.5])
+    np.testing.assert_array_equal(feats["y"], [3])
+    assert feats["s"] == [b"ab"]
+
+    with tempfile.NamedTemporaryFile(suffix=".tfrecord", delete=False) as f:
+        path = f.name
+    write_tfrecord(path, [example, example])
+    recs = list(TFRecordIterator(path))
+    assert len(recs) == 2 and recs[0] == example
+
+    pe = ops.ParseExample(["x"], [np.float32], [(2,)])
+    out = pe.forward(recs)
+    assert out.shape == (2, 2)
