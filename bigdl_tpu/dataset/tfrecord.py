@@ -33,17 +33,26 @@ def TFRecordIterator(path: str, check_crc: bool = True) -> Iterator[bytes]:
     reference's ``utils/tf``)."""
     from bigdl_tpu import native
 
+    def read_exact(f, n, what):
+        buf = f.read(n)
+        if len(buf) != n:
+            raise IOError(f"truncated TFRecord file {path}: short read "
+                          f"of {what} ({len(buf)}/{n} bytes)")
+        return buf
+
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
-            if len(header) < 8:
+            if not header:
                 return
+            if len(header) < 8:
+                raise IOError(f"truncated TFRecord file {path}: short header")
             (length,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
+            (hcrc,) = struct.unpack("<I", read_exact(f, 4, "header crc"))
             if check_crc and native.masked_crc32c(header) != hcrc:
                 raise IOError(f"corrupt TFRecord header in {path}")
-            data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
+            data = read_exact(f, length, "record data")
+            (dcrc,) = struct.unpack("<I", read_exact(f, 4, "data crc"))
             if check_crc and native.masked_crc32c(data) != dcrc:
                 raise IOError(f"corrupt TFRecord data in {path}")
             yield data

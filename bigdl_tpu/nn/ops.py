@@ -212,14 +212,19 @@ class OneHot(Operation):
         self.off_value = off_value
 
     def update_output(self, input):
+        depth, on, off = self.depth, self.on_value, self.off_value
         if isinstance(input, (tuple, list)):
-            indices, depth, on, off = (list(input) + [self.depth,
-                                                      self.on_value,
-                                                      self.off_value])[:4]
-            depth = int(depth)
+            indices = input[0]
+            if len(input) > 1:
+                depth = int(input[1])
+            if len(input) > 2:
+                on = input[2]
+            if len(input) > 3:
+                off = input[3]
         else:
-            indices, depth, on, off = (input, self.depth, self.on_value,
-                                       self.off_value)
+            indices = input
+        if depth is None:
+            raise ValueError("OneHot needs a depth (constructor or input)")
         oh = jax.nn.one_hot(jnp.asarray(indices), depth, axis=self.axis)
         return oh * on + (1 - oh) * off
 
@@ -313,10 +318,12 @@ class ResizeBilinearOps(Operation):
         self.align_corners = align_corners
 
     def update_output(self, input):
+        from bigdl_tpu.nn.layers.shape import ResizeBilinear
+
         images, size = input
         h, w = int(size[0]), int(size[1])
-        shape = images.shape[:-3] + (h, w, images.shape[-1])
-        return jax.image.resize(images, shape, method="bilinear")
+        return ResizeBilinear(h, w, align_corners=self.align_corners,
+                              format="NHWC").forward(images)
 
 
 class Slice(Operation):
@@ -330,6 +337,11 @@ class Slice(Operation):
     def update_output(self, input):
         sizes = tuple(input.shape[i] - b if s == -1 else s
                       for i, (b, s) in enumerate(zip(self.begin, self.size)))
+        for i, (b, s) in enumerate(zip(self.begin, sizes)):
+            if b + s > input.shape[i]:  # TF errors; don't clamp silently
+                raise ValueError(
+                    f"Slice out of bounds on dim {i}: begin {b} + size {s} "
+                    f"> {input.shape[i]}")
         return lax.dynamic_slice(input, self.begin, sizes)
 
 

@@ -113,7 +113,7 @@ def _use_interpret() -> bool:
 _LANES = 128
 
 
-def _causal_bounds(block_q, block_k, q_len, kv_len):
+def _causal_offset(q_len, kv_len):
     """off such that q row i attends k positions <= i + off."""
     return kv_len - q_len
 
@@ -129,7 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     nk = pl.num_programs(2)
     q_start = qi * block_q
     k_start = ki * block_k
-    off = _causal_bounds(block_q, block_k, q_len, kv_len)
+    off = _causal_offset(q_len, kv_len)
 
     @pl.when(ki == 0)
     def _init():
@@ -182,7 +182,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     nk = pl.num_programs(2)
     q_start = qi * block_q
     k_start = ki * block_k
-    off = _causal_bounds(block_q, block_k, q_len, kv_len)
+    off = _causal_offset(q_len, kv_len)
 
     @pl.when(ki == 0)
     def _init():
@@ -229,7 +229,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     nq = pl.num_programs(2)
     q_start = qi * block_q
     k_start = ki * block_k
-    off = _causal_bounds(block_q, block_k, q_len, kv_len)
+    off = _causal_offset(q_len, kv_len)
 
     @pl.when(qi == 0)
     def _init():

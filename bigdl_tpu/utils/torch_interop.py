@@ -1,0 +1,227 @@
+"""PyTorch interop: import/export module trees with weights.
+
+The reference's external-format interop is Torch7 (.t7 load/save,
+``utils/TorchFile.scala:67``) and Caffe (``utils/caffe/``); the living
+equivalent of "load a Torch model" is a ``torch.nn`` module.
+``from_torch`` converts a torch module tree (on CPU) into the
+corresponding bigdl_tpu modules with weights copied; ``to_torch`` goes
+the other way.  Both are host-side, used for parity testing (oracle
+comparisons against torch forward passes) and model migration.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["from_torch", "to_torch"]
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def from_torch(tmod) -> Any:
+    """Convert a ``torch.nn`` module (tree) to bigdl_tpu modules."""
+    import torch.nn as tnn
+
+    import bigdl_tpu.nn as nn
+
+    if isinstance(tmod, tnn.Sequential):
+        out = nn.Sequential()
+        for child in tmod:
+            out.add(from_torch(child))
+        return out
+    if isinstance(tmod, tnn.Linear):
+        m = nn.Linear(tmod.in_features, tmod.out_features,
+                      with_bias=tmod.bias is not None)
+        m.weight = _np(tmod.weight)
+        if tmod.bias is not None:
+            m.bias = _np(tmod.bias)
+        return m
+    if isinstance(tmod, tnn.Conv2d):
+        if tmod.dilation != (1, 1):
+            m = nn.SpatialDilatedConvolution(
+                tmod.in_channels, tmod.out_channels,
+                tmod.kernel_size[1], tmod.kernel_size[0],
+                tmod.stride[1], tmod.stride[0],
+                tmod.padding[1], tmod.padding[0],
+                tmod.dilation[1], tmod.dilation[0])
+        else:
+            m = nn.SpatialConvolution(
+                tmod.in_channels, tmod.out_channels,
+                tmod.kernel_size[1], tmod.kernel_size[0],
+                tmod.stride[1], tmod.stride[0],
+                tmod.padding[1], tmod.padding[0],
+                n_group=tmod.groups,
+                with_bias=tmod.bias is not None)
+        m.weight = _np(tmod.weight)  # both OIHW
+        if tmod.bias is not None:
+            m.bias = _np(tmod.bias)
+        return m
+    if isinstance(tmod, tnn.ConvTranspose2d):
+        m = nn.SpatialFullConvolution(
+            tmod.in_channels, tmod.out_channels,
+            tmod.kernel_size[1], tmod.kernel_size[0],
+            tmod.stride[1], tmod.stride[0],
+            tmod.padding[1], tmod.padding[0],
+            tmod.output_padding[1], tmod.output_padding[0])
+        m.weight = _np(tmod.weight)
+        if tmod.bias is not None:
+            m.bias = _np(tmod.bias)
+        return m
+    if isinstance(tmod, (tnn.BatchNorm1d, tnn.BatchNorm2d)):
+        cls = (nn.SpatialBatchNormalization
+               if isinstance(tmod, tnn.BatchNorm2d) else nn.BatchNormalization)
+        m = cls(tmod.num_features, eps=tmod.eps, momentum=tmod.momentum,
+                affine=tmod.affine)
+        if tmod.affine:
+            m.weight = _np(tmod.weight)
+            m.bias = _np(tmod.bias)
+        m.running_mean = _np(tmod.running_mean)
+        m.running_var = _np(tmod.running_var)
+        return m
+    if isinstance(tmod, tnn.LayerNorm):
+        m = nn.LayerNorm(tmod.normalized_shape[-1], eps=tmod.eps,
+                         affine=tmod.elementwise_affine)
+        if tmod.elementwise_affine:
+            m.weight = _np(tmod.weight)
+            m.bias = _np(tmod.bias)
+        return m
+    if isinstance(tmod, tnn.MaxPool2d):
+        k = tmod.kernel_size if isinstance(tmod.kernel_size, tuple) \
+            else (tmod.kernel_size,) * 2
+        s = tmod.stride if isinstance(tmod.stride, tuple) \
+            else (tmod.stride,) * 2
+        p = tmod.padding if isinstance(tmod.padding, tuple) \
+            else (tmod.padding,) * 2
+        m = nn.SpatialMaxPooling(k[1], k[0], s[1], s[0], p[1], p[0])
+        if tmod.ceil_mode:
+            m.ceil()
+        return m
+    if isinstance(tmod, tnn.AvgPool2d):
+        k = tmod.kernel_size if isinstance(tmod.kernel_size, tuple) \
+            else (tmod.kernel_size,) * 2
+        s = tmod.stride if isinstance(tmod.stride, tuple) \
+            else (tmod.stride,) * 2
+        p = tmod.padding if isinstance(tmod.padding, tuple) \
+            else (tmod.padding,) * 2
+        return nn.SpatialAveragePooling(k[1], k[0], s[1], s[0], p[1], p[0])
+    if isinstance(tmod, tnn.Embedding):
+        m = nn.LookupTable(tmod.num_embeddings, tmod.embedding_dim)
+        m.weight = _np(tmod.weight)
+        return m
+    if isinstance(tmod, tnn.Dropout):
+        return nn.Dropout(tmod.p)
+    if isinstance(tmod, tnn.Flatten):
+        return nn.InferReshape([0, -1])  # keep batch, flatten the rest
+    if isinstance(tmod, tnn.ReLU):
+        return nn.ReLU()
+    if isinstance(tmod, tnn.ReLU6):
+        return nn.ReLU6()
+    if isinstance(tmod, tnn.LeakyReLU):
+        return nn.LeakyReLU(tmod.negative_slope)
+    if isinstance(tmod, tnn.PReLU):
+        m = nn.PReLU(tmod.num_parameters if tmod.num_parameters > 1 else 0)
+        m.weight = _np(tmod.weight)
+        return m
+    if isinstance(tmod, tnn.ELU):
+        return nn.ELU(tmod.alpha)
+    if isinstance(tmod, tnn.Sigmoid):
+        return nn.Sigmoid()
+    if isinstance(tmod, tnn.Tanh):
+        return nn.Tanh()
+    if isinstance(tmod, tnn.Softmax):
+        return nn.SoftMax()
+    if isinstance(tmod, tnn.LogSoftmax):
+        return nn.LogSoftMax()
+    if isinstance(tmod, tnn.Identity):
+        return nn.Identity()
+    raise NotImplementedError(
+        f"from_torch: no converter for {type(tmod).__name__}")
+
+
+def to_torch(module) -> Any:
+    """Convert a bigdl_tpu module (tree) to ``torch.nn`` modules."""
+    import torch
+    import torch.nn as tnn
+
+    import bigdl_tpu.nn as nn
+
+    def tensor(a):
+        return torch.from_numpy(np.asarray(a).copy())
+
+    if isinstance(module, nn.Sequential):
+        return tnn.Sequential(*[to_torch(m)
+                                for m in module.__dict__["_modules"].values()])
+    if isinstance(module, nn.Linear):
+        t = tnn.Linear(module.input_size, module.output_size,
+                       bias=module.with_bias)
+        with torch.no_grad():
+            t.weight.copy_(tensor(module._params["weight"]))
+            if module.with_bias:
+                t.bias.copy_(tensor(module._params["bias"]))
+        return t
+    if isinstance(module, nn.SpatialConvolution):
+        t = tnn.Conv2d(module.n_input_plane, module.n_output_plane,
+                       (module.kernel_h, module.kernel_w),
+                       (module.stride_h, module.stride_w),
+                       (module.pad_h, module.pad_w),
+                       groups=module.n_group,
+                       bias="bias" in module._params)
+        with torch.no_grad():
+            t.weight.copy_(tensor(module._params["weight"]))
+            if "bias" in module._params:
+                t.bias.copy_(tensor(module._params["bias"]))
+        return t
+    if isinstance(module, nn.SpatialBatchNormalization):
+        t = tnn.BatchNorm2d(module.n_output, eps=module.eps,
+                            momentum=module.momentum, affine=module.affine)
+        with torch.no_grad():
+            if module.affine:
+                t.weight.copy_(tensor(module._params["weight"]))
+                t.bias.copy_(tensor(module._params["bias"]))
+            t.running_mean.copy_(tensor(module._buffers["running_mean"]))
+            t.running_var.copy_(tensor(module._buffers["running_var"]))
+        return t
+    if isinstance(module, nn.BatchNormalization):
+        t = tnn.BatchNorm1d(module.n_output, eps=module.eps,
+                            momentum=module.momentum, affine=module.affine)
+        with torch.no_grad():
+            if module.affine:
+                t.weight.copy_(tensor(module._params["weight"]))
+                t.bias.copy_(tensor(module._params["bias"]))
+            t.running_mean.copy_(tensor(module._buffers["running_mean"]))
+            t.running_var.copy_(tensor(module._buffers["running_var"]))
+        return t
+    if isinstance(module, nn.SpatialMaxPooling):
+        return tnn.MaxPool2d((module.kh, module.kw), (module.dh, module.dw),
+                             (module.pad_h, module.pad_w),
+                             ceil_mode=module.ceil_mode)
+    if isinstance(module, nn.SpatialAveragePooling):
+        return tnn.AvgPool2d((module.kh, module.kw), (module.dh, module.dw),
+                             (module.pad_h, module.pad_w))
+    if isinstance(module, nn.LookupTable):
+        t = tnn.Embedding(module.n_index, module.n_output)
+        with torch.no_grad():
+            t.weight.copy_(tensor(module._params["weight"]))
+        return t
+    if isinstance(module, nn.Dropout):
+        return tnn.Dropout(module.p)
+    if isinstance(module, nn.ReLU):
+        return tnn.ReLU()
+    if isinstance(module, nn.Tanh):
+        return tnn.Tanh()
+    if isinstance(module, nn.Sigmoid):
+        return tnn.Sigmoid()
+    if isinstance(module, nn.SoftMax):
+        return tnn.Softmax(dim=-1)
+    if isinstance(module, nn.LogSoftMax):
+        return tnn.LogSoftmax(dim=-1)
+    if isinstance(module, nn.Identity):
+        return tnn.Identity()
+    if isinstance(module, nn.InferReshape) and module.size == (0, -1):
+        return tnn.Flatten()
+    raise NotImplementedError(
+        f"to_torch: no converter for {type(module).__name__}")

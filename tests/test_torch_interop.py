@@ -1,0 +1,106 @@
+"""Torch interop: oracle comparisons of imported/exported module trees
+(the analogue of the reference's Torch-oracle specs, ``torch/TH.scala``)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from bigdl_tpu.utils.torch_interop import from_torch, to_torch  # noqa: E402
+
+
+def _assert_matches(tmod, x, rtol=1e-4, atol=1e-5):
+    tmod = tmod.eval()
+    with torch.no_grad():
+        expected = tmod(torch.from_numpy(x)).numpy()
+    m = from_torch(tmod).evaluate()
+    got = np.asarray(m.forward(x))
+    np.testing.assert_allclose(got, expected, rtol=rtol, atol=atol)
+    return m
+
+
+def test_import_mlp():
+    torch.manual_seed(0)
+    tmod = tnn.Sequential(tnn.Linear(8, 16), tnn.ReLU(), tnn.Linear(16, 4),
+                          tnn.LogSoftmax(dim=-1))
+    x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+    _assert_matches(tmod, x)
+
+
+def test_import_cnn():
+    torch.manual_seed(1)
+    tmod = tnn.Sequential(
+        tnn.Conv2d(3, 8, 3, stride=1, padding=1),
+        tnn.BatchNorm2d(8),
+        tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(8, 16, 3, padding=1, groups=2),
+        tnn.AvgPool2d(2, 2),
+        tnn.Flatten(),
+        tnn.Linear(16 * 2 * 2, 10),
+    )
+    # populate BN running stats with a training pass
+    tmod.train()
+    with torch.no_grad():
+        tmod(torch.randn(8, 3, 8, 8))
+    x = np.random.RandomState(1).randn(4, 3, 8, 8).astype(np.float32)
+    _assert_matches(tmod, x)
+
+
+def test_import_activations_embedding():
+    torch.manual_seed(2)
+    for act in [tnn.Sigmoid(), tnn.Tanh(), tnn.ELU(0.7), tnn.LeakyReLU(0.1),
+                tnn.ReLU6(), tnn.Softmax(dim=-1)]:
+        tmod = tnn.Sequential(tnn.Linear(6, 6), act)
+        x = np.random.RandomState(3).randn(3, 6).astype(np.float32)
+        _assert_matches(tmod, x)
+
+    emb = tnn.Embedding(20, 8)
+    m = from_torch(emb)
+    idx = np.array([[1, 5, 19], [0, 2, 3]])
+    with torch.no_grad():
+        expected = emb(torch.from_numpy(idx)).numpy()
+    np.testing.assert_allclose(np.asarray(m.forward(idx)), expected,
+                               rtol=1e-6)
+
+
+def test_import_transposed_and_dilated_conv():
+    torch.manual_seed(3)
+    tmod = tnn.Sequential(tnn.ConvTranspose2d(4, 6, 3, stride=2, padding=1,
+                                              output_padding=1))
+    x = np.random.RandomState(4).randn(2, 4, 5, 5).astype(np.float32)
+    _assert_matches(tmod, x)
+
+    tmod = tnn.Sequential(tnn.Conv2d(3, 6, 3, padding=2, dilation=2))
+    x = np.random.RandomState(5).randn(2, 3, 9, 9).astype(np.float32)
+    _assert_matches(tmod, x)
+
+
+def test_import_layernorm():
+    torch.manual_seed(4)
+    tmod = tnn.Sequential(tnn.Linear(12, 12), tnn.LayerNorm(12))
+    x = np.random.RandomState(6).randn(4, 12).astype(np.float32)
+    _assert_matches(tmod, x)
+
+
+def test_export_roundtrip():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(5)
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 6, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(6),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.InferReshape([0, -1]),
+        nn.Linear(6 * 4 * 4, 5),
+        nn.LogSoftMax(),
+    ).evaluate()
+    x = np.random.RandomState(7).randn(2, 3, 8, 8).astype(np.float32)
+    expected = np.asarray(model.forward(x))
+    tmod = to_torch(model).eval()
+    with torch.no_grad():
+        got = tmod(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
