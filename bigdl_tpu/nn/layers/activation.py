@@ -123,10 +123,16 @@ class LogSigmoid(Module):
 
 class SoftMax(Module):
     """Softmax over the feature axis (``nn/SoftMax.scala``: dim 1 of
-    [batch, n] or the only dim of [n])."""
+    [batch, n] or the only dim of [n]); ``axis`` overrides (extension,
+    used by torch interop for dim=-1 semantics)."""
+
+    def __init__(self, axis=None):
+        super().__init__()
+        self.axis = axis
 
     def update_output(self, input):
-        axis = 1 if input.ndim >= 2 else 0
+        axis = self.axis if self.axis is not None \
+            else (1 if input.ndim >= 2 else 0)
         return jax.nn.softmax(input, axis=axis)
 
 
@@ -137,10 +143,16 @@ class SoftMin(Module):
 
 
 class LogSoftMax(Module):
-    """(``nn/LogSoftMax.scala:21`` — MKL-accelerated there; XLA-fused here)."""
+    """(``nn/LogSoftMax.scala:21`` — MKL-accelerated there; XLA-fused
+    here); ``axis`` overrides the feature-axis default (extension)."""
+
+    def __init__(self, axis=None):
+        super().__init__()
+        self.axis = axis
 
     def update_output(self, input):
-        axis = 1 if input.ndim >= 2 else 0
+        axis = self.axis if self.axis is not None \
+            else (1 if input.ndim >= 2 else 0)
         return jax.nn.log_softmax(input, axis=axis)
 
 

@@ -1,0 +1,181 @@
+"""Tree-structured LSTMs (``nn/TreeLSTM.scala``, ``nn/BinaryTreeLSTM.scala``)
+and the Nms detection helper (``nn/Nms.scala``).
+
+The reference walks each sample's parse tree with host-side recursion and
+per-node cloned cell modules.  TPU-first redesign: all nodes are processed
+**vectorized per round** — each round gathers both children's (c, h) for
+every node and updates the nodes whose children are ready, so the whole
+forward is one ``lax.scan`` of depth ``node_count`` over MXU-batched gate
+matmuls, jit-able and reverse-differentiable (scan, not while_loop).
+
+Tree encoding matches the reference's ``TensorTree``: input =
+``(embeddings [B, leafNum, inputSize], trees [B, nodeNum, 3])`` where
+``trees[b, i] = (leftChild, rightChild, leafIndex)`` with 1-based node
+indices, 0 = no child; output = hidden states ``[B, nodeNum, hidden]``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.module import Container, Module
+
+__all__ = ["TreeLSTM", "BinaryTreeLSTM", "Nms"]
+
+
+class TreeLSTM(Container):
+    """Abstract tree LSTM (``nn/TreeLSTM.scala``)."""
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Constituency (binary) tree LSTM (``nn/BinaryTreeLSTM.scala:40``).
+
+    Leaf cell: c = W_c x; h = sigmoid(W_o x) * tanh(c) (when
+    ``gate_output``) — ``createLeafModuleWithGraph``.
+    Composer: gates i/lf/rf/update/o each = Linear(lh) + Linear(rh);
+    c = i*update + lf*lc + rf*rc; h = o * tanh(c) —
+    ``createComposerWithGraph``.  One shared parameter set for all leaves
+    and one for all composers (the reference shares via shareParams).
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True):
+        super().__init__(input_size, hidden_size)
+        self.gate_output = gate_output
+        self.leaf_c = Linear(input_size, hidden_size)
+        if gate_output:
+            self.leaf_o = Linear(input_size, hidden_size)
+        for gate in ("i", "lf", "rf", "u", "o"):
+            setattr(self, f"comp_{gate}_l", Linear(hidden_size, hidden_size))
+            setattr(self, f"comp_{gate}_r", Linear(hidden_size, hidden_size))
+
+    def _leaf(self, x):
+        c = self.leaf_c.forward(x)
+        if self.gate_output:
+            h = jax.nn.sigmoid(self.leaf_o.forward(x)) * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def _compose(self, lc, lh, rc, rh):
+        def gate(name):
+            return (getattr(self, f"comp_{name}_l").forward(lh) +
+                    getattr(self, f"comp_{name}_r").forward(rh))
+
+        i = jax.nn.sigmoid(gate("i"))
+        lf = jax.nn.sigmoid(gate("lf"))
+        rf = jax.nn.sigmoid(gate("rf"))
+        u = jnp.tanh(gate("u"))
+        c = i * u + lf * lc + rf * rc
+        if self.gate_output:
+            h = jax.nn.sigmoid(gate("o")) * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def update_output(self, input):
+        embeddings, trees = input
+        trees = jnp.asarray(trees).astype(jnp.int32)
+        b, node_num = trees.shape[0], trees.shape[1]
+        hid = self.hidden_size
+
+        left = trees[:, :, 0]      # [B, N], 1-based; 0 = none
+        right = trees[:, :, 1]
+        leaf_idx = trees[:, :, 2]  # 1-based index into embeddings
+        is_leaf = (left == 0) & (right == 0)
+        is_node = jnp.any(trees != 0, axis=-1)  # padding rows are all-zero
+
+        # leaf candidates for every slot (gather with clamped indices)
+        gath = jnp.take_along_axis(
+            embeddings, jnp.maximum(leaf_idx - 1, 0)[:, :, None], axis=1)
+        leaf_c, leaf_h = self._leaf(gath)  # [B, N, hid]
+
+        # state slot 0 is the "absent child" zero state
+        c0 = jnp.zeros((b, node_num + 1, hid), leaf_c.dtype)
+        h0 = jnp.zeros_like(c0)
+        ready0 = jnp.concatenate(
+            [jnp.ones((b, 1), bool), jnp.zeros((b, node_num), bool)], axis=1)
+
+        leaf_mask = is_leaf & is_node
+        c0 = c0.at[:, 1:].set(jnp.where(leaf_mask[:, :, None], leaf_c, 0.0))
+        h0 = h0.at[:, 1:].set(jnp.where(leaf_mask[:, :, None], leaf_h, 0.0))
+        ready0 = ready0.at[:, 1:].set(leaf_mask)
+
+        def round_fn(carry, _):
+            c, h, ready = carry
+            lc = jnp.take_along_axis(c, left[:, :, None], axis=1)
+            lh = jnp.take_along_axis(h, left[:, :, None], axis=1)
+            rc = jnp.take_along_axis(c, right[:, :, None], axis=1)
+            rh = jnp.take_along_axis(h, right[:, :, None], axis=1)
+            cand_c, cand_h = self._compose(lc, lh, rc, rh)  # [B, N, hid]
+            l_ready = jnp.take_along_axis(ready, left, axis=1)
+            r_ready = jnp.take_along_axis(ready, right, axis=1)
+            newly = (~is_leaf) & is_node & l_ready & r_ready \
+                & ~ready[:, 1:]
+            c = c.at[:, 1:].set(jnp.where(newly[:, :, None], cand_c,
+                                          c[:, 1:]))
+            h = h.at[:, 1:].set(jnp.where(newly[:, :, None], cand_h,
+                                          h[:, 1:]))
+            ready = ready.at[:, 1:].set(ready[:, 1:] | newly)
+            return (c, h, ready), None
+
+        # depth <= node_num rounds; scan keeps it reverse-differentiable
+        (c, h, ready), _ = lax.scan(round_fn, (c0, h0, ready0), None,
+                                    length=node_num)
+        return h[:, 1:, :]
+
+
+class Nms(Module):
+    """Greedy IoU non-max suppression (``nn/Nms.scala``): input =
+    (boxes [N, 4] xyxy, scores [N]); returns (keep_indices [max_out],
+    valid_count) with -1 padding.  Forward-only; O(N^2) masked, expressed
+    as a fori_loop so it lowers to one XLA computation."""
+
+    def __init__(self, threshold: float = 0.3, max_output: int = 100):
+        super().__init__()
+        self.threshold = threshold
+        self.max_output = max_output
+
+    def update_output(self, input):
+        boxes, scores = input
+        n = boxes.shape[0]
+        x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+        areas = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+
+        ix1 = jnp.maximum(x1[:, None], x1[None, :])
+        iy1 = jnp.maximum(y1[:, None], y1[None, :])
+        ix2 = jnp.minimum(x2[:, None], x2[None, :])
+        iy2 = jnp.minimum(y2[:, None], y2[None, :])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        iou = inter / jnp.maximum(areas[:, None] + areas[None, :] - inter,
+                                  1e-10)
+
+        max_out = min(self.max_output, n)
+
+        def body(i, carry):
+            alive, keep, count = carry
+            masked = jnp.where(alive, scores, -jnp.inf)
+            best = jnp.argmax(masked)
+            valid = masked[best] > -jnp.inf
+            keep = keep.at[i].set(jnp.where(valid, best, -1))
+            count = count + valid.astype(jnp.int32)
+            suppress = iou[best] > self.threshold
+            alive = alive & ~suppress & ~(jnp.arange(n) == best)
+            alive = alive & valid  # once empty, stay empty
+            return alive, keep, count
+
+        alive0 = jnp.ones((n,), bool)
+        keep0 = jnp.full((max_out,), -1, jnp.int32)
+        _, keep, count = lax.fori_loop(0, max_out, body,
+                                       (alive0, keep0, jnp.int32(0)))
+        return keep, count

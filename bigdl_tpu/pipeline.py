@@ -1,0 +1,121 @@
+"""ML-pipeline adapters: DLEstimator / DLClassifier / DLModel
+(``org/apache/spark/ml/DLEstimator.scala:54``, ``DLClassifier.scala`` —
+SURVEY §2.12).
+
+The reference adapts BigDL training into Spark ML's Estimator/Transformer
+contract over DataFrame feature/label columns.  The structural equivalent
+here is the sklearn-style fit/transform protocol over columnar numpy
+data: ``DLEstimator.fit(X, y) -> DLModel``; ``DLModel.transform(X) ->
+predictions``.  ``X``/``y`` may be arrays or anything convertible; rows
+are reshaped to ``feature_size``/``label_size`` like the reference's
+internalFit (``DLEstimator.scala:119-136``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DLEstimator", "DLClassifier", "DLModel", "DLClassifierModel"]
+
+
+class DLEstimator:
+    """Fit a module + criterion over columnar (X, y) data."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int]):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.batch_size = 32
+        self.max_epoch = 20
+        self.learning_rate = 1e-3
+        self.optim_method = None
+
+    def set_batch_size(self, n: int) -> "DLEstimator":
+        self.batch_size = n
+        return self
+
+    def set_max_epoch(self, n: int) -> "DLEstimator":
+        self.max_epoch = n
+        return self
+
+    def set_learning_rate(self, lr: float) -> "DLEstimator":
+        self.learning_rate = lr
+        return self
+
+    def set_optim_method(self, method) -> "DLEstimator":
+        self.optim_method = method
+        return self
+
+    def _make_model(self, trained):
+        return DLModel(trained, self.feature_size)
+
+    def fit(self, X, y) -> "DLModel":
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset.sample import Sample
+
+        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        y = np.asarray(y).reshape((-1,) + self.label_size)
+        samples = [Sample(X[i], y[i]) for i in range(len(X))]
+        method = self.optim_method or optim.Adam(
+            learning_rate=self.learning_rate)
+        o = optim.LocalOptimizer(
+            self.model, samples, self.criterion,
+            batch_size=self.batch_size,
+            end_trigger=optim.Trigger.max_epoch(self.max_epoch))
+        o.set_optim_method(method)
+        trained = o.optimize()
+        return self._make_model(trained)
+
+
+class DLModel:
+    """Fitted transformer (``DLEstimator.scala`` DLModel): appends
+    predictions for feature rows."""
+
+    def __init__(self, model, feature_size: Sequence[int]):
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.batch_size = 128
+
+    def set_batch_size(self, n: int) -> "DLModel":
+        self.batch_size = n
+        return self
+
+    def _forward_batches(self, X):
+        import jax.numpy as jnp
+
+        model = self.model.evaluate()
+        outs = []
+        for i in range(0, len(X), self.batch_size):
+            outs.append(np.asarray(
+                model.forward(jnp.asarray(X[i:i + self.batch_size]))))
+        return np.concatenate(outs, axis=0)
+
+    def transform(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        return self._forward_batches(X)
+
+
+class DLClassifier(DLEstimator):
+    """Classification specialization (``DLClassifier.scala``): labels are
+    class indices; transform yields argmax class predictions."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int]):
+        super().__init__(model, criterion, feature_size, (1,))
+
+    def _make_model(self, trained):
+        return DLClassifierModel(trained, self.feature_size)
+
+    def fit(self, X, y) -> "DLClassifierModel":
+        y = np.asarray(y).reshape(-1)
+        return super().fit(X, y.astype(np.int64))
+
+
+class DLClassifierModel(DLModel):
+    def transform(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        out = self._forward_batches(X)
+        return out.argmax(axis=-1)

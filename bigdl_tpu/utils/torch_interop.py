@@ -41,19 +41,30 @@ def from_torch(tmod) -> Any:
             m.bias = _np(tmod.bias)
         return m
     if isinstance(tmod, tnn.Conv2d):
+        if isinstance(tmod.padding, str):
+            # torch 'same'/'valid' -> SAME (-1) / 0 per the conv layers'
+            # TF-style pad convention
+            pad_w = pad_h = {"same": -1, "valid": 0}[tmod.padding]
+        else:
+            pad_w, pad_h = tmod.padding[1], tmod.padding[0]
         if tmod.dilation != (1, 1):
+            if tmod.groups != 1:
+                raise NotImplementedError(
+                    "from_torch: dilated grouped Conv2d is unsupported")
             m = nn.SpatialDilatedConvolution(
                 tmod.in_channels, tmod.out_channels,
                 tmod.kernel_size[1], tmod.kernel_size[0],
                 tmod.stride[1], tmod.stride[0],
-                tmod.padding[1], tmod.padding[0],
+                pad_w, pad_h,
                 tmod.dilation[1], tmod.dilation[0])
+            if tmod.bias is None:
+                m.bias = np.zeros((tmod.out_channels,), np.float32)
         else:
             m = nn.SpatialConvolution(
                 tmod.in_channels, tmod.out_channels,
                 tmod.kernel_size[1], tmod.kernel_size[0],
                 tmod.stride[1], tmod.stride[0],
-                tmod.padding[1], tmod.padding[0],
+                pad_w, pad_h,
                 n_group=tmod.groups,
                 with_bias=tmod.bias is not None)
         m.weight = _np(tmod.weight)  # both OIHW
@@ -61,12 +72,16 @@ def from_torch(tmod) -> Any:
             m.bias = _np(tmod.bias)
         return m
     if isinstance(tmod, tnn.ConvTranspose2d):
+        if tmod.groups != 1:
+            raise NotImplementedError(
+                "from_torch: grouped ConvTranspose2d is unsupported")
         m = nn.SpatialFullConvolution(
             tmod.in_channels, tmod.out_channels,
             tmod.kernel_size[1], tmod.kernel_size[0],
             tmod.stride[1], tmod.stride[0],
             tmod.padding[1], tmod.padding[0],
-            tmod.output_padding[1], tmod.output_padding[0])
+            tmod.output_padding[1], tmod.output_padding[0],
+            no_bias=tmod.bias is None)
         m.weight = _np(tmod.weight)
         if tmod.bias is not None:
             m.bias = _np(tmod.bias)
@@ -83,6 +98,10 @@ def from_torch(tmod) -> Any:
         m.running_var = _np(tmod.running_var)
         return m
     if isinstance(tmod, tnn.LayerNorm):
+        if len(tmod.normalized_shape) != 1:
+            raise NotImplementedError(
+                "from_torch: LayerNorm over multiple trailing dims is "
+                "unsupported (last-dim only)")
         m = nn.LayerNorm(tmod.normalized_shape[-1], eps=tmod.eps,
                          affine=tmod.elementwise_affine)
         if tmod.elementwise_affine:
@@ -90,6 +109,9 @@ def from_torch(tmod) -> Any:
             m.bias = _np(tmod.bias)
         return m
     if isinstance(tmod, tnn.MaxPool2d):
+        if tmod.dilation not in (1, (1, 1)):
+            raise NotImplementedError(
+                "from_torch: dilated MaxPool2d is unsupported")
         k = tmod.kernel_size if isinstance(tmod.kernel_size, tuple) \
             else (tmod.kernel_size,) * 2
         s = tmod.stride if isinstance(tmod.stride, tuple) \
@@ -107,7 +129,10 @@ def from_torch(tmod) -> Any:
             else (tmod.stride,) * 2
         p = tmod.padding if isinstance(tmod.padding, tuple) \
             else (tmod.padding,) * 2
-        return nn.SpatialAveragePooling(k[1], k[0], s[1], s[0], p[1], p[0])
+        m = nn.SpatialAveragePooling(k[1], k[0], s[1], s[0], p[1], p[0])
+        if tmod.ceil_mode:
+            m.ceil()
+        return m
     if isinstance(tmod, tnn.Embedding):
         m = nn.LookupTable(tmod.num_embeddings, tmod.embedding_dim)
         m.weight = _np(tmod.weight)
@@ -133,9 +158,9 @@ def from_torch(tmod) -> Any:
     if isinstance(tmod, tnn.Tanh):
         return nn.Tanh()
     if isinstance(tmod, tnn.Softmax):
-        return nn.SoftMax()
+        return nn.SoftMax(axis=tmod.dim)
     if isinstance(tmod, tnn.LogSoftmax):
-        return nn.LogSoftMax()
+        return nn.LogSoftMax(axis=tmod.dim)
     if isinstance(tmod, tnn.Identity):
         return nn.Identity()
     raise NotImplementedError(

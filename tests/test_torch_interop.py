@@ -104,3 +104,39 @@ def test_export_roundtrip():
     with torch.no_grad():
         got = tmod(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_import_no_bias_and_edge_cases():
+    torch.manual_seed(5)
+    # bias=False variants must not leak random biases
+    tmod = tnn.Sequential(tnn.Conv2d(3, 6, 3, padding=2, dilation=2,
+                                     bias=False))
+    x = np.random.RandomState(8).randn(2, 3, 9, 9).astype(np.float32)
+    _assert_matches(tmod, x)
+    tmod = tnn.Sequential(tnn.ConvTranspose2d(3, 6, 3, stride=2, padding=1,
+                                              output_padding=1, bias=False))
+    x = np.random.RandomState(9).randn(2, 3, 5, 5).astype(np.float32)
+    _assert_matches(tmod, x)
+    # string padding
+    tmod = tnn.Sequential(tnn.Conv2d(3, 4, 3, padding="same"))
+    x = np.random.RandomState(10).randn(2, 3, 8, 8).astype(np.float32)
+    _assert_matches(tmod, x)
+    # ceil-mode avg pool shape parity
+    tmod = tnn.Sequential(tnn.AvgPool2d(2, 2, ceil_mode=True))
+    x = np.random.RandomState(11).randn(1, 3, 5, 5).astype(np.float32)
+    _assert_matches(tmod, x)
+    # softmax over last dim of 3-D input
+    tmod = tnn.Sequential(tnn.Softmax(dim=-1))
+    x = np.random.RandomState(12).randn(2, 4, 5).astype(np.float32)
+    _assert_matches(tmod, x)
+
+
+def test_import_unsupported_configs_raise():
+    with pytest.raises(NotImplementedError):
+        from_torch(tnn.MaxPool2d(3, 1, dilation=2))
+    with pytest.raises(NotImplementedError):
+        from_torch(tnn.LayerNorm((4, 5)))
+    with pytest.raises(NotImplementedError):
+        from_torch(tnn.ConvTranspose2d(4, 4, 3, groups=2))
+    with pytest.raises(NotImplementedError):
+        from_torch(tnn.Conv2d(4, 4, 3, dilation=2, groups=2))

@@ -1,0 +1,126 @@
+"""BinaryTreeLSTM, Nms, and the DLEstimator/DLClassifier pipeline
+adapters."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+
+
+def _tiny_tree():
+    """5-node tree: nodes 1,2 leaves (emb 1,2); node 3 = (1,2);
+    node 4 leaf (emb 3); node 5 = root (3,4)."""
+    tree = np.zeros((5, 3), np.int32)
+    tree[0] = (0, 0, 1)
+    tree[1] = (0, 0, 2)
+    tree[2] = (1, 2, 0)
+    tree[3] = (0, 0, 3)
+    tree[4] = (3, 4, 0)
+    return tree
+
+
+def _reference_forward(m, emb, tree):
+    """Host-side recursion oracle (the reference's recursiveForward)."""
+    def rec(i):
+        left, right, leaf = tree[i - 1]
+        if left == 0 and right == 0:
+            return m._leaf(emb[leaf - 1])
+        lc, lh = rec(left)
+        rc, rh = rec(right)
+        return m._compose(lc, lh, rc, rh)
+
+    states = {}
+    for i in range(1, tree.shape[0] + 1):
+        if np.any(tree[i - 1] != 0):
+            states[i] = rec(i)
+    return states
+
+
+def test_binary_tree_lstm_matches_recursion_oracle():
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(0)
+    m = nn.BinaryTreeLSTM(4, 6)
+    rng = np.random.RandomState(0)
+    emb = jnp.asarray(rng.randn(1, 3, 4), jnp.float32)
+    tree = _tiny_tree()[None]
+    out = m.forward((emb, jnp.asarray(tree)))
+    assert out.shape == (1, 5, 6)
+    oracle = _reference_forward(m, emb[0], tree[0])
+    for i, (c, h) in oracle.items():
+        np.testing.assert_allclose(np.asarray(out[0, i - 1]),
+                                   np.asarray(h), rtol=1e-5, atol=1e-5)
+
+
+def test_binary_tree_lstm_trains_under_jit():
+    from bigdl_tpu.nn.module import functional_call, state_dict
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(1)
+    m = nn.BinaryTreeLSTM(4, 6)
+    rng = np.random.RandomState(1)
+    emb = jnp.asarray(rng.randn(2, 3, 4), jnp.float32)
+    trees = jnp.asarray(np.stack([_tiny_tree(), _tiny_tree()]))
+    params = state_dict(m, kind="param")
+
+    @jax.jit
+    def loss(p):
+        out, _ = functional_call(m, p, (emb, trees))
+        return jnp.sum(out[:, -1, :] ** 2)  # root hidden state
+
+    grads = jax.grad(loss)(params)
+    assert set(grads) == set(params)
+    nz = [k for k, g in grads.items() if float(jnp.max(jnp.abs(g))) > 0]
+    assert any("comp_" in k for k in nz) and any("leaf_" in k for k in nz)
+
+
+def test_nms():
+    boxes = jnp.asarray([
+        [0, 0, 10, 10],
+        [1, 1, 10.5, 10.5],   # heavy overlap with box 0
+        [20, 20, 30, 30],
+        [100, 100, 110, 110],
+    ], jnp.float32)
+    scores = jnp.asarray([0.9, 0.95, 0.8, 0.1])
+    keep, count = nn.Nms(threshold=0.5, max_output=4).forward((boxes, scores))
+    assert int(count) == 3
+    kept = sorted(int(i) for i in np.asarray(keep)[:int(count)])
+    assert kept == [1, 2, 3]  # box 0 suppressed by higher-scoring box 1
+
+
+def test_dl_classifier_pipeline():
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.pipeline import DLClassifier
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(2)
+    rng = np.random.RandomState(2)
+    X = rng.randn(128, 4).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.int64)
+    model = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    est = DLClassifier(model, nn.ClassNLLCriterion(), [4]) \
+        .set_batch_size(32).set_max_epoch(30) \
+        .set_optim_method(optim.SGD(learning_rate=0.5))
+    fitted = est.fit(X, y)
+    pred = fitted.transform(X)
+    assert pred.shape == (128,)
+    assert (pred == y).mean() > 0.9
+
+
+def test_dl_estimator_regression():
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.pipeline import DLEstimator
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(3)
+    rng = np.random.RandomState(3)
+    X = rng.randn(128, 3).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5], np.float32))[:, None]
+    est = DLEstimator(nn.Sequential(nn.Linear(3, 1)), nn.MSECriterion(),
+                      [3], [1]).set_batch_size(32).set_max_epoch(40) \
+        .set_optim_method(optim.SGD(learning_rate=0.1))
+    fitted = est.fit(X, y)
+    pred = fitted.transform(X)
+    assert float(np.mean((pred - y) ** 2)) < 0.05
