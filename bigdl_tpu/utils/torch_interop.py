@@ -41,6 +41,10 @@ def from_torch(tmod) -> Any:
             m.bias = _np(tmod.bias)
         return m
     if isinstance(tmod, tnn.Conv2d):
+        if tmod.padding_mode != "zeros":
+            raise NotImplementedError(
+                f"from_torch: Conv2d padding_mode={tmod.padding_mode!r} "
+                "is unsupported (zeros only)")
         if isinstance(tmod.padding, str):
             # torch 'same'/'valid' -> SAME (-1) / 0 per the conv layers'
             # TF-style pad convention
@@ -72,6 +76,9 @@ def from_torch(tmod) -> Any:
             m.bias = _np(tmod.bias)
         return m
     if isinstance(tmod, tnn.ConvTranspose2d):
+        if tmod.dilation not in (1, (1, 1)):
+            raise NotImplementedError(
+                "from_torch: dilated ConvTranspose2d is unsupported")
         if tmod.groups != 1:
             raise NotImplementedError(
                 "from_torch: grouped ConvTranspose2d is unsupported")
@@ -129,7 +136,9 @@ def from_torch(tmod) -> Any:
             else (tmod.stride,) * 2
         p = tmod.padding if isinstance(tmod.padding, tuple) \
             else (tmod.padding,) * 2
-        m = nn.SpatialAveragePooling(k[1], k[0], s[1], s[0], p[1], p[0])
+        m = nn.SpatialAveragePooling(
+            k[1], k[0], s[1], s[0], p[1], p[0],
+            count_include_pad=tmod.count_include_pad)
         if tmod.ceil_mode:
             m.ceil()
         return m
@@ -158,8 +167,15 @@ def from_torch(tmod) -> Any:
     if isinstance(tmod, tnn.Tanh):
         return nn.Tanh()
     if isinstance(tmod, tnn.Softmax):
+        if tmod.dim is None:
+            raise NotImplementedError(
+                "from_torch: Softmax without an explicit dim is unsupported")
         return nn.SoftMax(axis=tmod.dim)
     if isinstance(tmod, tnn.LogSoftmax):
+        if tmod.dim is None:
+            raise NotImplementedError(
+                "from_torch: LogSoftmax without an explicit dim is "
+                "unsupported")
         return nn.LogSoftMax(axis=tmod.dim)
     if isinstance(tmod, tnn.Identity):
         return nn.Identity()
