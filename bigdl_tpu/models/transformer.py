@@ -1,0 +1,68 @@
+"""Transformer language model — the long-context flagship.
+
+The reference has no attention or transformer models (SURVEY §5
+"Long-context ... Absent"); this model exists to exercise the
+capabilities the TPU build adds on top of the reference's sequence
+story (RNN/TimeDistributed): the Pallas flash kernel and ring/Ulysses
+sequence parallelism over a mesh ``seq`` axis.
+
+``build_transformer_lm`` returns a causal decoder LM:
+token embedding + learned positions -> N pre-norm TransformerBlocks ->
+final LayerNorm -> vocab head (log-probs per position, so
+``TimeDistributedCriterion(ClassNLLCriterion())`` trains it).
+
+``sp_mesh``/``sp_axis``/``sp_strategy`` route every block's attention
+through shard_map'd ring or Ulysses attention for sequences larger than
+one chip holds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = ["build_transformer_lm", "PositionalEmbedding"]
+
+
+class PositionalEmbedding(Module):
+    """Learned absolute positions added to token embeddings."""
+
+    def __init__(self, max_len: int, embed_dim: int):
+        super().__init__()
+        self.max_len = max_len
+        self.weight = Parameter(jnp.zeros((max_len, embed_dim), jnp.float32))
+
+    def update_output(self, input):
+        s = input.shape[1]
+        return input + self._params["weight"][None, :s, :]
+
+
+def build_transformer_lm(vocab_size: int, num_layers: int = 4,
+                         embed_dim: int = 256, num_heads: int = 8,
+                         max_len: int = 1024, mlp_ratio: int = 4,
+                         dropout: float = 0.0, backend="auto",
+                         sp_mesh=None, sp_axis: str = "seq",
+                         sp_strategy: str = "ring") -> nn.Module:
+    """Causal decoder-only LM over [batch, seq] token ids."""
+    if sp_mesh is not None:
+        from bigdl_tpu.parallel.sequence import (
+            make_sequence_parallel_attention)
+
+        backend = make_sequence_parallel_attention(
+            sp_mesh, strategy=sp_strategy, axis_name=sp_axis, causal=True)
+    model = nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim),
+        PositionalEmbedding(max_len, embed_dim),
+    )
+    for _ in range(num_layers):
+        model.add(nn.TransformerBlock(embed_dim, num_heads,
+                                      mlp_ratio=mlp_ratio, dropout=dropout,
+                                      causal=True, backend=backend))
+    model.add(nn.LayerNorm(embed_dim))
+    model.add(nn.TimeDistributed(nn.Sequential(
+        nn.Linear(embed_dim, vocab_size), nn.LogSoftMax())))
+    return model

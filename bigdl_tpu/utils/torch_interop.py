@@ -130,6 +130,9 @@ def from_torch(tmod) -> Any:
             m.ceil()
         return m
     if isinstance(tmod, tnn.AvgPool2d):
+        if tmod.divisor_override is not None:
+            raise NotImplementedError(
+                "from_torch: AvgPool2d divisor_override is unsupported")
         k = tmod.kernel_size if isinstance(tmod.kernel_size, tuple) \
             else (tmod.kernel_size,) * 2
         s = tmod.stride if isinstance(tmod.stride, tuple) \
@@ -242,7 +245,9 @@ def to_torch(module) -> Any:
                              ceil_mode=module.ceil_mode)
     if isinstance(module, nn.SpatialAveragePooling):
         return tnn.AvgPool2d((module.kh, module.kw), (module.dh, module.dw),
-                             (module.pad_h, module.pad_w))
+                             (module.pad_h, module.pad_w),
+                             ceil_mode=module.ceil_mode,
+                             count_include_pad=module.count_include_pad)
     if isinstance(module, nn.LookupTable):
         t = tnn.Embedding(module.n_index, module.n_output)
         with torch.no_grad():
