@@ -69,43 +69,11 @@ def write_tfrecord(path: str, records) -> None:
 
 
 # ---------------------------------------------------------------------------
-# proto wire-format decoding (just enough for Example)
+# proto wire-format decoding (shared helpers in bigdl_tpu.utils.protowire)
 # ---------------------------------------------------------------------------
 
-def _read_varint(buf: bytes, pos: int):
-    result = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-
-
-def _fields(buf: bytes):
-    """Yield (field_number, wire_type, value) over a message buffer."""
-    pos = 0
-    n = len(buf)
-    while pos < n:
-        tag, pos = _read_varint(buf, pos)
-        field, wt = tag >> 3, tag & 7
-        if wt == 0:  # varint
-            val, pos = _read_varint(buf, pos)
-        elif wt == 1:  # 64-bit
-            val = buf[pos:pos + 8]
-            pos += 8
-        elif wt == 2:  # length-delimited
-            ln, pos = _read_varint(buf, pos)
-            val = buf[pos:pos + ln]
-            pos += ln
-        elif wt == 5:  # 32-bit
-            val = buf[pos:pos + 4]
-            pos += 4
-        else:
-            raise ValueError(f"unsupported wire type {wt}")
-        yield field, wt, val
+from bigdl_tpu.utils.protowire import (fields as _fields,  # noqa: E402
+                                       packed_floats, packed_varints)
 
 
 def _parse_feature(buf: bytes) -> Union[List[bytes], np.ndarray]:
@@ -115,27 +83,14 @@ def _parse_feature(buf: bytes) -> Union[List[bytes], np.ndarray]:
         if field == 2:  # FloatList
             floats: List[float] = []
             for f, w, v in _fields(val):
-                if f != 1:
-                    continue
-                if w == 2:  # packed
-                    floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
-                else:
-                    floats.append(struct.unpack("<f", v)[0])
+                if f == 1:
+                    floats.extend(packed_floats(v, w))
             return np.asarray(floats, np.float32)
         if field == 3:  # Int64List
             ints: List[int] = []
             for f, w, v in _fields(val):
-                if f != 1:
-                    continue
-                if w == 2:  # packed varints
-                    p = 0
-                    while p < len(v):
-                        x, p = _read_varint(v, p)
-                        ints.append(x)
-                else:
-                    ints.append(v)
-            # varints are unsigned on the wire; fold back to signed int64
-            ints = [x - (1 << 64) if x >= (1 << 63) else x for x in ints]
+                if f == 1:
+                    ints.extend(packed_varints(v, w))
             return np.asarray(ints, np.int64)
     return np.asarray([], np.float32)
 

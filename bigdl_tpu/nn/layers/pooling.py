@@ -107,13 +107,15 @@ class SpatialMaxPooling(_PoolBase):
     """(``nn/SpatialMaxPooling.scala``); pad == -1 means SAME (per axis)."""
 
     def __init__(self, kw: int, kh: int, dw: Optional[int] = None, dh: Optional[int] = None,
-                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW"):
+                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW",
+                 global_pooling: bool = False):
         super().__init__()
         self.kw, self.kh = kw, kh
         self.dw, self.dh = dw or kw, dh or kh
         self.pad_w, self.pad_h = pad_w, pad_h
         self.format = format
         self.ceil_mode = False
+        self.global_pooling = global_pooling
 
     def ceil(self):
         self.ceil_mode = True
@@ -131,7 +133,15 @@ class SpatialMaxPooling(_PoolBase):
         return [(h_ax, self.kh, self.dh, self.pad_h),
                 (w_ax, self.kw, self.dw, self.pad_w)]
 
+    def _apply_global(self, input):
+        if self.global_pooling:
+            spec = self._axes_spec(input.ndim)
+            (h_ax, *_), (w_ax, *_) = spec
+            self.kh, self.kw = input.shape[h_ax], input.shape[w_ax]
+            self.dh, self.dw = self.kh, self.kw
+
     def update_output(self, input):
+        self._apply_global(input)
         return self._max(input)
 
 
@@ -149,11 +159,7 @@ class SpatialAveragePooling(SpatialMaxPooling):
         self.divide = divide
 
     def update_output(self, input):
-        if self.global_pooling:
-            spec = self._axes_spec(input.ndim)
-            (h_ax, *_), (w_ax, *_) = spec
-            self.kh, self.kw = input.shape[h_ax], input.shape[w_ax]
-            self.dh, self.dw = self.kh, self.kw
+        self._apply_global(input)
         return self._avg(input, self.count_include_pad, self.divide)
 
 

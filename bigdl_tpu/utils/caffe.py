@@ -1,0 +1,478 @@
+"""Caffe model import: prototxt structure + caffemodel weights
+(``utils/caffe/CaffeLoader.scala:56``, ``Converter.scala``,
+``LayerConverter.scala``/``V1LayerConverter.scala`` — SURVEY §2.9).
+
+Two pieces, neither needing a protobuf runtime:
+
+- ``parse_prototxt``: a parser for protobuf *text* format (the grammar
+  prototxt uses: ``key: value`` scalars and ``key { ... }`` nested
+  messages, repeated keys collected into lists).
+- ``load_caffemodel_blobs``: binary NetParameter decoding via
+  ``bigdl_tpu.utils.protowire`` — handles both V2 ``layer`` (field 100)
+  and legacy V1 ``layers`` (field 2) with per-layer BlobProtos (shape /
+  legacy num-channels-height-width dims, packed float data).
+
+``CaffeLoader.load`` builds a ``Graph`` from the layer DAG (bottom/top
+wiring, TRAIN-phase layers skipped) with weights copied by layer name,
+covering the converter table: Convolution/Deconvolution, InnerProduct,
+Pooling(MAX/AVE), ReLU, TanH, Sigmoid, Softmax(+WithLoss), LRN, Dropout,
+Concat, Eltwise(SUM/PROD/MAX), BatchNorm(+Scale), Flatten, Reshape,
+Power, AbsVal, Exp, Log.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import protowire as pw
+
+__all__ = ["parse_prototxt", "load_caffemodel_blobs", "CaffeLoader",
+           "load_caffe"]
+
+
+# ---------------------------------------------------------------------------
+# prototxt (protobuf text format)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""
+    \s*                               # whitespace (comments pre-stripped)
+    (?P<tok>
+        [A-Za-z_][A-Za-z0-9_]* |      # identifier
+        "(?:[^"\\]|\\.)*"        |    # string
+        '(?:[^'\\]|\\.)*'        |    # string
+        -?[0-9.][0-9.eE+\-]*     |    # number
+        [{}:,]                        # punctuation
+    )""", re.VERBOSE)
+
+
+def _tokenize(text: str) -> List[str]:
+    text = re.sub(r"#[^\n]*", "", text)  # strip comments up-front
+    toks = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"prototxt parse error near {text[pos:pos+40]!r}")
+        toks.append(m.group("tok"))
+        pos = m.end()
+    return toks
+
+
+def _convert_scalar(tok: str):
+    if tok and tok[0] in "\"'":
+        return tok[1:-1]
+    if tok in ("true", "True"):
+        return True
+    if tok in ("false", "False"):
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        try:
+            return float(tok)
+        except ValueError:
+            return tok  # enum identifier (MAX, AVE, TRAIN, ...)
+
+
+def _parse_message(toks: List[str], i: int) -> Tuple[Dict, int]:
+    msg: Dict = {}
+
+    def put(key, value):
+        if key in msg:
+            if not isinstance(msg[key], list):
+                msg[key] = [msg[key]]
+            msg[key].append(value)
+        else:
+            msg[key] = value
+
+    while i < len(toks) and toks[i] != "}":
+        key = toks[i]
+        i += 1
+        if i < len(toks) and toks[i] == ":":
+            i += 1
+            if toks[i] == "{":
+                sub, i = _parse_message(toks, i + 1)
+                assert toks[i] == "}"
+                put(key, sub)
+                i += 1
+            else:
+                put(key, _convert_scalar(toks[i]))
+                i += 1
+        elif i < len(toks) and toks[i] == "{":
+            sub, i = _parse_message(toks, i + 1)
+            assert toks[i] == "}"
+            put(key, sub)
+            i += 1
+        else:
+            raise ValueError(f"prototxt parse error at token {key!r}")
+        if i < len(toks) and toks[i] == ",":
+            i += 1
+    return msg, i
+
+
+def parse_prototxt(text: str) -> Dict:
+    """Parse protobuf text format into nested dicts; repeated keys become
+    lists."""
+    toks = _tokenize(text)
+    msg, i = _parse_message(toks, 0)
+    return msg
+
+
+def _as_list(v) -> List:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# caffemodel binary (NetParameter)
+# ---------------------------------------------------------------------------
+
+def _parse_blob(buf: bytes) -> np.ndarray:
+    shape: List[int] = []
+    legacy = {}
+    data: List[float] = []
+    for f, wt, val in pw.fields(buf):
+        if f == 7:  # BlobShape { repeated int64 dim = 1 }
+            for f2, wt2, v2 in pw.fields(val):
+                if f2 == 1:
+                    shape.extend(pw.packed_varints(v2, wt2))
+        elif f == 5:  # repeated float data
+            data.extend(pw.packed_floats(val, wt))
+        elif f in (1, 2, 3, 4):  # legacy num/channels/height/width
+            legacy[f] = val
+    arr = np.asarray(data, np.float32)
+    if not shape and legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+        while len(shape) > 1 and shape[0] == 1:
+            shape = shape[1:]
+    return arr.reshape(shape) if shape else arr
+
+
+def load_caffemodel_blobs(path: str) -> Dict[str, List[np.ndarray]]:
+    """{layer_name: [blob arrays]} from a binary .caffemodel (V1 + V2)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    blobs: Dict[str, List[np.ndarray]] = {}
+    for f_no, wt, val in pw.fields(buf):
+        if f_no not in (100, 2):  # layer (V2) / layers (V1)
+            continue
+        name = None
+        layer_blobs: List[np.ndarray] = []
+        name_field = 1 if f_no == 100 else 4
+        blob_field = 7 if f_no == 100 else 6
+        for f2, wt2, v2 in pw.fields(val):
+            if f2 == name_field and isinstance(v2, bytes):
+                name = v2.decode("utf-8", "replace")
+            elif f2 == blob_field:
+                layer_blobs.append(_parse_blob(v2))
+        if name and layer_blobs:
+            blobs[name] = layer_blobs
+    return blobs
+
+
+# ---------------------------------------------------------------------------
+# layer conversion
+# ---------------------------------------------------------------------------
+
+def _pair(param, key, default=0):
+    """Caffe's h/w convention: ``key_h``/``key_w`` override scalar/repeated
+    ``key``."""
+    h = param.get(f"{key}_h")
+    w = param.get(f"{key}_w")
+    if h is not None or w is not None:
+        return int(h or default), int(w or default)
+    v = _as_list(param.get(key, default))
+    if not v:
+        v = [default]
+    if len(v) == 1:
+        return int(v[0]), int(v[0])
+    return int(v[0]), int(v[1])
+
+
+class CaffeLoader:
+    """Build a bigdl_tpu ``Graph`` from prototxt (+ optional caffemodel
+    weights), mirroring ``CaffeLoader.scala``'s converter table."""
+
+    def __init__(self, prototxt_path: str,
+                 caffemodel_path: Optional[str] = None,
+                 customized_converters: Optional[Dict] = None):
+        """``customized_converters``: {layer_type: fn(layer_dict,
+        in_channels, blobs) -> (module, out_channels)} for layer types
+        outside the built-in table (the reference's customizedConverters
+        hook, ``CaffeLoader.scala:56``)."""
+        with open(prototxt_path) as f:
+            self.net = parse_prototxt(f.read())
+        self.blobs = (load_caffemodel_blobs(caffemodel_path)
+                      if caffemodel_path else {})
+        self.customized = dict(customized_converters or {})
+
+    # -- channel inference -------------------------------------------------
+    def _input_channels(self) -> Dict[str, int]:
+        chans: Dict[str, int] = {}
+        names = _as_list(self.net.get("input"))
+        if names:
+            if "input_shape" in self.net:
+                shapes = _as_list(self.net["input_shape"])
+                for nm, sh in zip(names, shapes):
+                    dims = _as_list(sh.get("dim"))
+                    if len(dims) >= 2:
+                        chans[nm] = int(dims[1])
+            elif "input_dim" in self.net:
+                dims = _as_list(self.net["input_dim"])
+                for i, nm in enumerate(names):
+                    if 4 * i + 1 < len(dims):
+                        chans[nm] = int(dims[4 * i + 1])
+        for lay in self._layers():
+            if lay.get("type") == "Input":
+                dims = _as_list(lay.get("input_param", {})
+                                .get("shape", {}).get("dim"))
+                if len(dims) >= 2:
+                    for top in _as_list(lay.get("top")):
+                        chans[top] = int(dims[1])
+        return chans
+
+    def _layers(self) -> List[Dict]:
+        return _as_list(self.net.get("layer")) + _as_list(
+            self.net.get("layers"))
+
+    @staticmethod
+    def _is_train_only(lay) -> bool:
+        for inc in _as_list(lay.get("include")):
+            if isinstance(inc, dict) and inc.get("phase") == "TRAIN":
+                return True
+        return False
+
+    # -- conversion --------------------------------------------------------
+    def _convert(self, lay: Dict, in_channels: Optional[int]):
+        """Return a module or None (passthrough/skip)."""
+        import bigdl_tpu.nn as nn
+
+        t = str(lay.get("type"))
+        name = lay.get("name", "?")
+        if t in ("Convolution", "Deconvolution", "4", "39"):
+            p = lay.get("convolution_param", {})
+            n_out = int(p["num_output"])
+            kh, kw = _pair(p, "kernel_size")
+            dh, dw = _pair(p, "stride", 1)
+            ph, pw_ = _pair(p, "pad", 0)
+            groups = int(p.get("group", 1))
+            bias = bool(p.get("bias_term", True))
+            n_in = in_channels
+            if n_in is None:
+                w = self.blobs.get(name)
+                if w and w[0].ndim == 4:
+                    # conv blobs are (out, in/g, kh, kw); deconv (in, out/g, ...)
+                    n_in = (w[0].shape[0] if t in ("Deconvolution", "39")
+                            else w[0].shape[1] * groups)
+            if n_in is None:
+                raise ValueError(
+                    f"cannot infer input channels for layer {name}")
+            if t in ("Deconvolution", "39"):
+                m = nn.SpatialFullConvolution(n_in, n_out, kw, kh, dw, dh,
+                                              pw_, ph, no_bias=not bias)
+            else:
+                m = nn.SpatialConvolution(n_in, n_out, kw, kh, dw, dh,
+                                          pw_, ph, n_group=groups,
+                                          with_bias=bias)
+            w = self.blobs.get(name)
+            if w:
+                m.weight = w[0].reshape(m._params["weight"].shape)
+                if bias and len(w) > 1:
+                    m.bias = w[1].reshape(-1)
+            return m, n_out
+        if t in ("InnerProduct", "14"):
+            p = lay.get("inner_product_param", {})
+            n_out = int(p["num_output"])
+            bias = bool(p.get("bias_term", True))
+            w = self.blobs.get(name)
+            if w:
+                weight = w[0].reshape(n_out, -1)
+                n_in = weight.shape[1]
+            elif in_channels is not None:
+                n_in = in_channels
+                weight = None
+            else:
+                raise ValueError(f"cannot infer input size for {name}")
+            lin = nn.Linear(n_in, n_out, with_bias=bias)
+            if w:
+                lin.weight = weight
+                if bias and len(w) > 1:
+                    lin.bias = w[1].reshape(-1)
+            return nn.Sequential(nn.InferReshape([0, -1]), lin), n_out
+        if t in ("Pooling", "17"):
+            p = lay.get("pooling_param", {})
+            kh, kw = _pair(p, "kernel_size")
+            dh, dw = _pair(p, "stride", 1)
+            ph, pw_ = _pair(p, "pad", 0)
+            pool = p.get("pool", "MAX")
+            glob = bool(p.get("global_pooling", False))
+            if pool in ("MAX", 0):
+                m = nn.SpatialMaxPooling(kw or 1, kh or 1, dw, dh, pw_, ph,
+                                         global_pooling=glob)
+                m.ceil()  # caffe pooling uses ceil output sizes
+            else:
+                m = nn.SpatialAveragePooling(kw or 1, kh or 1, dw, dh,
+                                             pw_, ph,
+                                             global_pooling=glob,
+                                             ceil_mode=True)
+            return m, in_channels
+        if t in ("ReLU", "18"):
+            return nn.ReLU(), in_channels
+        if t in ("TanH", "23"):
+            return nn.Tanh(), in_channels
+        if t in ("Sigmoid", "19"):
+            return nn.Sigmoid(), in_channels
+        if t in ("Softmax", "20", "SoftmaxWithLoss", "21"):
+            return nn.SoftMax(), in_channels
+        if t in ("LRN", "15"):
+            p = lay.get("lrn_param", {})
+            return nn.SpatialCrossMapLRN(
+                int(p.get("local_size", 5)), float(p.get("alpha", 1.0)),
+                float(p.get("beta", 0.75)), float(p.get("k", 1.0))), \
+                in_channels
+        if t in ("Dropout", "6"):
+            p = lay.get("dropout_param", {})
+            return nn.Dropout(float(p.get("dropout_ratio", 0.5))), \
+                in_channels
+        if t == "Concat":
+            axis = int(lay.get("concat_param", {}).get(
+                "axis", lay.get("concat_dim", 1)))
+            return ("concat", axis), None
+        if t == "Eltwise":
+            p = lay.get("eltwise_param", {})
+            op = p.get("operation", "SUM")
+            coeff = [float(c) for c in _as_list(p.get("coeff"))]
+            if op in ("SUM", 1) and coeff and coeff != [1.0] * len(coeff):
+                if coeff == [1.0, -1.0]:
+                    return "sub", in_channels
+                raise NotImplementedError(
+                    f"Eltwise SUM with coeff {coeff} is unsupported "
+                    "(only all-ones or [1, -1])")
+            return {"SUM": "add", 1: "add", "PROD": "mul", 0: "mul",
+                    "MAX": "max", 2: "max"}[op], in_channels
+        if t == "BatchNorm":
+            w = self.blobs.get(name)
+            n = w[0].size if w else in_channels
+            m = nn.SpatialBatchNormalization(n, affine=False)
+            if w:
+                scale = 1.0 / w[2].reshape(-1)[0] if len(w) > 2 and \
+                    w[2].reshape(-1)[0] != 0 else 1.0
+                m.running_mean = w[0].reshape(-1) * scale
+                m.running_var = w[1].reshape(-1) * scale
+            m.evaluate()
+            return m, in_channels
+        if t == "Scale":
+            w = self.blobs.get(name)
+            n = w[0].size if w else (in_channels or 1)
+            m = nn.CMul((1, n, 1, 1))
+            if w:
+                m.weight = w[0].reshape(1, n, 1, 1)
+            if w and len(w) > 1:
+                m = nn.Sequential(m, _make_cadd(n, w[1]))
+            return m, in_channels
+        if t == "Flatten":
+            return nn.InferReshape([0, -1]), in_channels
+        if t == "Reshape":
+            dims = _as_list(lay.get("reshape_param", {})
+                            .get("shape", {}).get("dim"))
+            return nn.InferReshape([int(d) for d in dims]), None
+        if t == "Power":
+            p = lay.get("power_param", {})
+            return nn.Power(float(p.get("power", 1.0)),
+                            float(p.get("scale", 1.0)),
+                            float(p.get("shift", 0.0))), in_channels
+        if t == "AbsVal":
+            return nn.Abs(), in_channels
+        if t == "Exp":
+            return nn.Exp(), in_channels
+        if t == "Log":
+            return nn.Log(), in_channels
+        if t in ("Input", "Data", "5", "12", "Accuracy", "Silence"):
+            return None, in_channels
+        if t in self.customized:
+            return self.customized[t](lay, in_channels,
+                                      self.blobs.get(name))
+        raise NotImplementedError(
+            f"CaffeLoader: unsupported layer type {t!r} (layer {name!r})")
+
+    def load(self):
+        """Build the Graph.  Returns (model, input_names, output_names)."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.graph import Node, node_from_module
+
+        chans = self._input_channels()
+        produced: Dict[str, Node] = {}
+        channels: Dict[str, Optional[int]] = dict(chans)
+        inputs: Dict[str, Node] = {}
+
+        def blob_node(bname: str) -> Node:
+            if bname not in produced:
+                node = nn.Input(name=bname)
+                produced[bname] = node
+                inputs[bname] = node
+            return produced[bname]
+
+        consumed = set()
+        for lay in self._layers():
+            if self._is_train_only(lay):
+                continue
+            bottoms = _as_list(lay.get("bottom"))
+            tops = _as_list(lay.get("top"))
+            name = lay.get("name", tops[0] if tops else "?")
+            in_ch = channels.get(bottoms[0]) if bottoms else None
+            mod, out_ch = self._convert(lay, in_ch)
+            if mod is None:  # data/input/accuracy layer
+                for tpn in tops:
+                    if tpn in chans or not bottoms:
+                        blob_node(tpn)
+                continue
+            consumed.update(bottoms)
+            if isinstance(mod, (str, tuple)):  # concat/eltwise fan-in
+                srcs = [blob_node(b) for b in bottoms]
+                if isinstance(mod, tuple):  # ("concat", axis)
+                    join = nn.JoinTable(mod[1], 0)
+                    out_ch = (sum(channels.get(b) or 0 for b in bottoms)
+                              or None) if mod[1] == 1 \
+                        else channels.get(bottoms[0])
+                else:
+                    join = {"add": nn.CAddTable(),
+                            "sub": nn.CSubTable(),
+                            "mul": nn.CMulTable(),
+                            "max": nn.CMaxTable()}[mod]
+                    out_ch = channels.get(bottoms[0])
+                join.set_name(name)
+                node = node_from_module(join, srcs)
+            else:
+                mod.set_name(name)
+                node = node_from_module(mod, [blob_node(b) for b in bottoms])
+            for tpn in tops:
+                produced[tpn] = node
+                channels[tpn] = out_ch
+
+        outputs = [produced[b] for b in produced
+                   if b not in consumed and produced[b] not in
+                   inputs.values()]
+        model = nn.Graph(list(inputs.values()), outputs)
+        return model, list(inputs.keys()), \
+            [b for b in produced if b not in consumed
+             and produced[b] not in inputs.values()]
+
+
+def _make_cadd(n: int, bias: np.ndarray):
+    import bigdl_tpu.nn as nn
+
+    m = nn.CAdd((1, n, 1, 1))
+    m.bias = bias.reshape(1, n, 1, 1)
+    return m
+
+
+def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None):
+    """Load a Caffe model; returns the bigdl_tpu Graph module."""
+    model, _, _ = CaffeLoader(prototxt_path, caffemodel_path).load()
+    return model

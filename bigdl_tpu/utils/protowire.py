@@ -1,0 +1,74 @@
+"""Minimal protobuf wire-format decoding, shared by the TFRecord Example
+parser (``bigdl_tpu.dataset.tfrecord``) and the Caffe model loader
+(``bigdl_tpu.utils.caffe``).
+
+The reference ships ~180k lines of protoc-generated Java for its caffe/
+tensorflow/serialization schemas (SURVEY §2.1); here the handful of
+message shapes actually needed are decoded directly from the wire."""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Tuple, Union
+
+__all__ = ["read_varint", "fields", "packed_floats", "packed_varints"]
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def fields(buf: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
+    """Yield (field_number, wire_type, value) over a message buffer.
+    Length-delimited and fixed-width values come back as bytes; varints
+    as unsigned ints."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, pos = read_varint(buf, pos)
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def packed_floats(val: Union[int, bytes], wt: int) -> List[float]:
+    """Decode one occurrence of a repeated-float field (packed or not)."""
+    if wt == 2:
+        return list(struct.unpack(f"<{len(val) // 4}f", val))
+    return [struct.unpack("<f", val)[0]]
+
+
+def packed_varints(val: Union[int, bytes], wt: int) -> List[int]:
+    """Decode one occurrence of a repeated-varint field (packed or not),
+    folding unsigned wire values back to signed int64."""
+    if wt == 2:
+        out = []
+        pos = 0
+        while pos < len(val):
+            x, pos = read_varint(val, pos)
+            out.append(x)
+    else:
+        out = [val]
+    return [x - (1 << 64) if x >= (1 << 63) else x for x in out]
+

@@ -244,6 +244,10 @@ def to_torch(module) -> Any:
                              (module.pad_h, module.pad_w),
                              ceil_mode=module.ceil_mode)
     if isinstance(module, nn.SpatialAveragePooling):
+        if module.global_pooling or not module.divide:
+            raise NotImplementedError(
+                "to_torch: global_pooling / divide=False AvgPooling has no "
+                "AvgPool2d equivalent (use AdaptiveAvgPool2d manually)")
         return tnn.AvgPool2d((module.kh, module.kw), (module.dh, module.dw),
                              (module.pad_h, module.pad_w),
                              ceil_mode=module.ceil_mode,

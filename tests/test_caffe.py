@@ -1,0 +1,241 @@
+"""Caffe import: prototxt text-format parsing, binary caffemodel blob
+decoding, DAG building, and a numeric oracle comparison against torch."""
+
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.caffe import (CaffeLoader, load_caffe,
+                                   load_caffemodel_blobs, parse_prototxt)
+
+PROTOTXT = """
+name: "testnet"  # a comment
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer {
+  name: "relu1"
+  type: "ReLU"
+  bottom: "conv1"
+  top: "conv1"
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "ip1"
+  inner_product_param { num_output: 5 }
+}
+layer {
+  name: "prob"
+  type: "Softmax"
+  bottom: "ip1"
+  top: "prob"
+}
+"""
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _ld(field, payload):
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape_msg = b"".join(_varint((1 << 3) | 0) + _varint(d)
+                         for d in arr.shape)
+    data = struct.pack(f"<{arr.size}f", *arr.reshape(-1))
+    return _ld(7, shape_msg) + _ld(5, data)
+
+
+def _layer_v2(name, blobs):
+    body = _ld(1, name.encode())
+    for b in blobs:
+        body += _ld(7, _blob(b))
+    return _ld(100, body)
+
+
+def _make_caffemodel(path, weights):
+    buf = b"".join(_layer_v2(n, bs) for n, bs in weights.items())
+    with open(path, "wb") as f:
+        f.write(buf)
+
+
+@pytest.fixture
+def caffe_files():
+    rng = np.random.RandomState(0)
+    w = {
+        "conv1": [rng.randn(4, 3, 3, 3).astype(np.float32),
+                  rng.randn(4).astype(np.float32)],
+        "ip1": [rng.randn(5, 4 * 4 * 4).astype(np.float32),
+                rng.randn(5).astype(np.float32)],
+    }
+    proto = tempfile.mktemp(suffix=".prototxt")
+    model = tempfile.mktemp(suffix=".caffemodel")
+    with open(proto, "w") as f:
+        f.write(PROTOTXT)
+    _make_caffemodel(model, w)
+    return proto, model, w
+
+
+def test_parse_prototxt():
+    net = parse_prototxt(PROTOTXT)
+    assert net["name"] == "testnet"
+    assert net["input"] == "data"
+    assert net["input_dim"] == [1, 3, 8, 8]
+    layers = net["layer"]
+    assert [l["type"] for l in layers] == \
+        ["Convolution", "ReLU", "Pooling", "InnerProduct", "Softmax"]
+    assert layers[0]["convolution_param"]["num_output"] == 4
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+
+
+def test_caffemodel_blob_roundtrip(caffe_files):
+    _, model, w = caffe_files
+    blobs = load_caffemodel_blobs(model)
+    assert set(blobs) == {"conv1", "ip1"}
+    np.testing.assert_allclose(blobs["conv1"][0], w["conv1"][0])
+    np.testing.assert_allclose(blobs["ip1"][1], w["ip1"][1])
+
+
+def test_load_caffe_oracle_vs_torch(caffe_files):
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+
+    proto, model_path, w = caffe_files
+    model = load_caffe(proto, model_path).evaluate()
+    x = np.random.RandomState(1).randn(1, 3, 8, 8).astype(np.float32)
+    got = np.asarray(model.forward(x))
+
+    ref = tnn.Sequential(
+        tnn.Conv2d(3, 4, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2, 2),
+        tnn.Flatten(), tnn.Linear(4 * 4 * 4, 5), tnn.Softmax(dim=-1))
+    with torch.no_grad():
+        ref[0].weight.copy_(torch.from_numpy(w["conv1"][0]))
+        ref[0].bias.copy_(torch.from_numpy(w["conv1"][1]))
+        ref[4].weight.copy_(torch.from_numpy(w["ip1"][0]))
+        ref[4].bias.copy_(torch.from_numpy(w["ip1"][1]))
+        expected = ref(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_load_caffe_branching_eltwise():
+    proto_text = """
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+        convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "c2" type: "Convolution" bottom: "data" top: "c2"
+        convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum"
+        eltwise_param { operation: SUM } }
+layer { name: "cat" type: "Concat" bottom: "c1" bottom: "sum" top: "cat" }
+"""
+    proto = tempfile.mktemp(suffix=".prototxt")
+    with open(proto, "w") as f:
+        f.write(proto_text)
+    model = load_caffe(proto).evaluate()
+    x = np.random.RandomState(2).randn(1, 2, 4, 4).astype(np.float32)
+    out = model.forward(x)
+    assert out.shape == (1, 4, 4, 4)  # concat of 2+2 channels
+
+
+def test_train_phase_layers_skipped():
+    proto_text = """
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 4 dim: 4 }
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+        convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "trainaug" type: "Dropout" bottom: "c" top: "c"
+        include { phase: TRAIN } }
+"""
+    proto = tempfile.mktemp(suffix=".prototxt")
+    with open(proto, "w") as f:
+        f.write(proto_text)
+    loader = CaffeLoader(proto)
+    model, ins, outs = loader.load()
+    names = [m.get_name() for m in model.__dict__["_modules"].values()]
+    assert "trainaug" not in names
+
+
+def test_customized_converter_hook():
+    import bigdl_tpu.nn as nn
+
+    proto_text = """
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 4 dim: 4 }
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+        convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "dummy" type: "Dummy" bottom: "c" top: "d" }
+"""
+    proto = tempfile.mktemp(suffix=".prototxt")
+    with open(proto, "w") as f:
+        f.write(proto_text)
+    loader = CaffeLoader(
+        proto, customized_converters={
+            "Dummy": lambda lay, in_ch, blobs: (nn.ReLU(), in_ch)})
+    model, _, _ = loader.load()
+    x = np.random.RandomState(3).randn(1, 3, 4, 4).astype(np.float32)
+    assert model.forward(x).shape == (1, 2, 4, 4)
+
+
+def test_global_pooling_and_eltwise_coeff_and_concat_axis():
+    proto_text = """
+# leading comment
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "gmax" type: "Pooling" bottom: "data" top: "gmax"
+        pooling_param { pool: MAX global_pooling: true } }
+# trailing comment"""
+    proto = tempfile.mktemp(suffix=".prototxt")
+    with open(proto, "w") as f:
+        f.write(proto_text)
+    model = load_caffe(proto).evaluate()
+    x = np.random.RandomState(4).randn(1, 2, 4, 4).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    assert out.shape == (1, 2, 1, 1)
+    np.testing.assert_allclose(out.reshape(2), x.max(axis=(2, 3)).reshape(2))
+
+    proto_text2 = """
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+        convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "diff" type: "Eltwise" bottom: "data" bottom: "c1" top: "d"
+        eltwise_param { operation: SUM coeff: 1 coeff: -1 } }
+layer { name: "cat2" type: "Concat" bottom: "d" bottom: "c1" top: "cat"
+        concat_param { axis: 2 } }
+"""
+    proto2 = tempfile.mktemp(suffix=".prototxt")
+    with open(proto2, "w") as f:
+        f.write(proto_text2)
+    model2 = load_caffe(proto2).evaluate()
+    out2 = model2.forward(x)
+    assert out2.shape == (1, 2, 8, 4)  # concat along axis 2
