@@ -67,11 +67,13 @@ class Conv2D(Operation):
     input NHWC (or NCHW), filter [kh, kw, cin, cout]."""
 
     def __init__(self, stride_h: int = 1, stride_w: int = 1,
-                 padding: str = "SAME", format: str = "NHWC"):
+                 padding: str = "SAME", format: str = "NHWC",
+                 dilation_h: int = 1, dilation_w: int = 1):
         super().__init__()
         self.strides = (stride_h, stride_w)
         self.padding = padding
         self.format = format
+        self.dilation = (dilation_h, dilation_w)
 
     def update_output(self, input):
         x, w = input
@@ -80,7 +82,7 @@ class Conv2D(Operation):
             (self.format, "HWIO", self.format))
         return lax.conv_general_dilated(
             x, w, window_strides=self.strides, padding=self.padding,
-            dimension_numbers=dn)
+            rhs_dilation=self.dilation, dimension_numbers=dn)
 
 
 class _PoolOp(Operation):

@@ -72,3 +72,32 @@ def packed_varints(val: Union[int, bytes], wt: int) -> List[int]:
         out = [val]
     return [x - (1 << 64) if x >= (1 << 63) else x for x in out]
 
+
+
+# ---------------------------------------------------------------------------
+# encoding (used by the TF GraphDef exporter)
+# ---------------------------------------------------------------------------
+
+def write_varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def emit_varint(field: int, value: int) -> bytes:
+    return write_varint((field << 3) | 0) + write_varint(value)
+
+
+def emit_bytes(field: int, payload: bytes) -> bytes:
+    return write_varint((field << 3) | 2) + write_varint(len(payload)) \
+        + payload
+
+
+def emit_float(field: int, value: float) -> bytes:
+    return write_varint((field << 3) | 5) + struct.pack("<f", value)

@@ -1,0 +1,172 @@
+"""TF GraphDef interop: hand-encoded GraphDef import, export round-trip,
+and trainable-const import."""
+
+import struct
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import protowire as pw
+from bigdl_tpu.utils.tf_graph import (load_graphdef, parse_graphdef,
+                                      save_graphdef)
+
+_DT_FLOAT, _DT_INT32 = 1, 3
+
+
+def _attr(key, payload):
+    return pw.emit_bytes(5, pw.emit_bytes(1, key.encode())
+                         + pw.emit_bytes(2, payload))
+
+
+def _tensor(arr, dt):
+    arr = np.asarray(arr)
+    shape = b"".join(pw.emit_bytes(2, pw.emit_varint(1, d))
+                     for d in arr.shape)
+    return (pw.emit_varint(1, dt) + pw.emit_bytes(2, shape)
+            + pw.emit_bytes(4, arr.tobytes()))
+
+
+def _node(name, op, inputs=(), attrs=b""):
+    body = pw.emit_bytes(1, name.encode()) + pw.emit_bytes(2, op.encode())
+    for i in inputs:
+        body += pw.emit_bytes(3, i.encode())
+    return pw.emit_bytes(1, body + attrs)
+
+
+def _const(name, arr, dt=_DT_FLOAT):
+    return _node(name, "Const", (),
+                 _attr("dtype", pw.emit_varint(6, dt))
+                 + _attr("value", pw.emit_bytes(8, _tensor(arr, dt))))
+
+
+def _make_mlp_graphdef(w1, b1, w2):
+    """x @ w1 + b1 -> relu -> @ w2 -> softmax"""
+    gd = b""
+    gd += _node("x", "Placeholder", (),
+                _attr("dtype", pw.emit_varint(6, _DT_FLOAT)))
+    gd += _const("w1", w1)
+    gd += _const("b1", b1)
+    gd += _const("w2", w2)
+    gd += _node("mm1", "MatMul", ("x", "w1"))
+    gd += _node("add1", "BiasAdd", ("mm1", "b1"))
+    gd += _node("relu", "Relu", ("add1",))
+    gd += _node("mm2", "MatMul", ("relu", "w2"))
+    gd += _node("prob", "Softmax", ("mm2",))
+    return gd
+
+
+@pytest.fixture
+def mlp_graphdef():
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(6, 8).astype(np.float32)
+    b1 = rng.randn(8).astype(np.float32)
+    w2 = rng.randn(8, 3).astype(np.float32)
+    return _make_mlp_graphdef(w1, b1, w2), (w1, b1, w2)
+
+
+def test_parse_graphdef(mlp_graphdef):
+    gd, (w1, b1, w2) = mlp_graphdef
+    nodes = parse_graphdef(gd)
+    byname = {n["name"]: n for n in nodes}
+    assert byname["mm1"]["op"] == "MatMul"
+    assert byname["mm1"]["inputs"] == ["x", "w1"]
+    np.testing.assert_allclose(byname["w1"]["attrs"]["value"], w1)
+
+
+def test_import_mlp_graphdef(mlp_graphdef):
+    gd, (w1, b1, w2) = mlp_graphdef
+    model = load_graphdef(gd, ["x"], ["prob"]).evaluate()
+    x = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    got = np.asarray(model.forward(x))
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    expected = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_import_train_consts(mlp_graphdef):
+    from bigdl_tpu.nn.module import state_dict
+
+    gd, _ = mlp_graphdef
+    model = load_graphdef(gd, ["x"], ["prob"], train_consts=True)
+    params = state_dict(model, kind="param")
+    # w1, b1, w2 become trainable Variables
+    assert len(params) == 3
+
+
+def test_import_conv_pool_ops():
+    rng = np.random.RandomState(2)
+    w = rng.randn(3, 3, 2, 4).astype(np.float32)  # HWIO
+    gd = b""
+    gd += _node("x", "Placeholder", ())
+    gd += _const("w", w)
+    gd += _node("conv", "Conv2D", ("x", "w"),
+                _attr("padding", pw.emit_bytes(2, b"SAME"))
+                + _attr("strides", pw.emit_bytes(
+                    1, b"".join(pw.emit_varint(3, i) for i in (1, 1, 1, 1)))))
+    gd += _node("relu", "Relu", ("conv",))
+    gd += _node("pool", "MaxPool", ("relu",),
+                _attr("padding", pw.emit_bytes(2, b"VALID"))
+                + _attr("ksize", pw.emit_bytes(
+                    1, b"".join(pw.emit_varint(3, i) for i in (1, 2, 2, 1))))
+                + _attr("strides", pw.emit_bytes(
+                    1, b"".join(pw.emit_varint(3, i) for i in (1, 2, 2, 1)))))
+    model = load_graphdef(gd, ["x"], ["pool"]).evaluate()
+    x = rng.randn(1, 8, 8, 2).astype(np.float32)
+    out = model.forward(x)
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_import_reshape_concat_mean():
+    gd = b""
+    gd += _node("x", "Placeholder", ())
+    gd += _const("shape", np.asarray([-1, 4], np.int32), _DT_INT32)
+    gd += _node("rs", "Reshape", ("x", "shape"))
+    gd += _const("axis", np.asarray([1], np.int32), _DT_INT32)
+    gd += _node("mean", "Mean", ("rs", "axis"),
+                _attr("keep_dims", pw.emit_varint(5, 1)))
+    model = load_graphdef(gd, ["x"], ["mean"]).evaluate()
+    x = np.arange(8.0, dtype=np.float32).reshape(2, 2, 2)
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(out, x.reshape(2, 4).mean(1, keepdims=True))
+
+
+def test_export_import_roundtrip():
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(3)
+    model = nn.Sequential(
+        nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4), nn.LogSoftMax(),
+    ).evaluate()
+    path = tempfile.mktemp(suffix=".pb")
+    outputs = save_graphdef(model, path, input_name="input")
+    re = load_graphdef(path, ["input"], outputs).evaluate()
+    x = np.random.RandomState(4).randn(3, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(re.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_import_cnn_roundtrip():
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(5)
+    model = nn.Sequential(
+        nn.SpatialConvolution(2, 4, 3, 3),  # VALID
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.InferReshape([0, -1]),
+        nn.Linear(4 * 3 * 3, 5),
+    ).evaluate()
+    path = tempfile.mktemp(suffix=".pb")
+    outputs = save_graphdef(model, path)
+    re = load_graphdef(path, ["input"], outputs).evaluate()
+    x = np.random.RandomState(6).randn(2, 2, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(re.forward(x)),
+                               np.asarray(model.forward(x)),
+                               rtol=1e-4, atol=1e-5)
