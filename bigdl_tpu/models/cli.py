@@ -1,0 +1,248 @@
+"""Model-zoo command-line entry points (SURVEY §2.13: each reference
+model ships scopt-based ``Train``/``Test`` mains, e.g.
+``models/lenet/Train.scala``, plus the synthetic-data perf harnesses
+``models/utils/{Local,Distri}OptimizerPerf.scala``).
+
+Usage::
+
+    python -m bigdl_tpu.models.cli train  --model lenet  -f ./mnist -b 64
+    python -m bigdl_tpu.models.cli test   --model lenet  -f ./mnist \
+        --checkpoint ./ckpt
+    python -m bigdl_tpu.models.cli perf   --model inception_v1 -b 64 -i 10
+
+``train`` runs the full Optimizer loop (validation every epoch, optional
+checkpointing and TensorBoard summaries, resume from snapshot);
+``test`` reloads a checkpoint and evaluates Top1/Top5; ``perf`` is the
+LocalOptimizerPerf protocol (synthetic data, records/sec after warmup).
+Missing dataset folders fall back to synthetic data so every command is
+runnable anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _build_model(name: str, num_classes: int):
+    from bigdl_tpu import models
+
+    builders = {
+        "lenet": lambda: models.build_lenet5(num_classes or 10),
+        "vgg16": lambda: models.build_vgg16(num_classes or 1000),
+        "vgg19": lambda: models.build_vgg19(num_classes or 1000),
+        "vgg_cifar": lambda: models.build_vgg_for_cifar10(num_classes or 10),
+        "inception_v1": lambda: models.build_inception_v1(
+            num_classes or 1000),
+        "inception_v2": lambda: models.build_inception_v2(
+            num_classes or 1000),
+        "resnet": lambda: models.build_resnet_cifar(20, num_classes or 10),
+        "resnet50": lambda: models.build_resnet(50, num_classes or 1000),
+        "autoencoder": lambda: models.build_autoencoder(),
+        "lstm": lambda: models.build_lstm_classifier(5000,
+                                                     class_num=num_classes
+                                                     or 2),
+        "transformer": lambda: models.build_transformer_lm(
+            vocab_size=num_classes or 256),
+    }
+    if name not in builders:
+        raise SystemExit(f"unknown --model {name!r}; choose from "
+                         f"{sorted(builders)}")
+    return builders[name]()
+
+
+def _load_data(model_name: str, folder: Optional[str], split: str
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    from bigdl_tpu.dataset import datasets
+
+    if model_name in ("lenet", "autoencoder"):
+        imgs, labels = datasets.load_mnist(folder, split)
+        x = ((imgs.astype(np.float32) / 255.0) - 0.1307) / 0.3081
+        x = x.reshape(-1, 1, 28, 28)
+    else:
+        imgs, labels = datasets.load_cifar10(folder, split)
+        x = imgs.astype(np.float32) / 255.0
+        x = (x - x.mean((0, 1, 2))) / (x.std((0, 1, 2)) + 1e-7)
+        x = x.transpose(0, 3, 1, 2)
+    return x, labels
+
+
+def cmd_train(args) -> None:
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(args.seed)
+    model = _build_model(args.model, args.num_classes)
+    if args.model_snapshot:
+        from bigdl_tpu.utils import serializer
+
+        model = serializer.load_module(args.model_snapshot)
+    x, y = _load_data(args.model, args.folder, "train")
+    xt, yt = _load_data(args.model, args.folder, "test")
+
+    if args.model == "autoencoder":
+        flat = x.reshape(len(x), -1)
+        samples = [Sample(flat[i], flat[i]) for i in range(len(flat))]
+        criterion = nn.MSECriterion()
+        val_methods = [optim.Loss(nn.MSECriterion())]
+        val_samples = samples[:256]
+    else:
+        samples = [Sample(x[i], y[i]) for i in range(len(x))]
+        criterion = nn.ClassNLLCriterion()
+        val_methods = [optim.Top1Accuracy(), optim.Top5Accuracy()]
+        val_samples = [Sample(xt[i], yt[i]) for i in range(len(xt))]
+
+    method = optim.SGD(learning_rate=args.learning_rate,
+                       momentum=args.momentum,
+                       weight_decay=args.weight_decay)
+    if args.state_snapshot:
+        from bigdl_tpu.utils import serializer
+
+        method = serializer.load_optim_method(args.state_snapshot)
+
+    o = optim.LocalOptimizer(
+        model, samples, criterion, batch_size=args.batch_size,
+        end_trigger=optim.Trigger.max_epoch(args.max_epoch))
+    o.set_optim_method(method)
+    o.set_validation(optim.Trigger.every_epoch(), val_samples, val_methods,
+                     batch_size=args.batch_size)
+    if args.checkpoint:
+        o.set_checkpoint(args.checkpoint, optim.Trigger.every_epoch())
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+        o.set_train_summary(TrainSummary(args.summary_dir, args.app_name))
+        o.set_validation_summary(
+            ValidationSummary(args.summary_dir, args.app_name))
+    trained = o.optimize()
+    res = optim.Evaluator(trained).evaluate(val_samples, val_methods,
+                                            batch_size=args.batch_size)
+    for r, m in res:
+        print(f"final {m}: {r}")
+
+
+def cmd_test(args) -> None:
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.utils import serializer
+
+    if args.model_snapshot:
+        model = serializer.load_module(args.model_snapshot)
+    elif args.checkpoint:
+        import glob
+
+        cands = sorted(glob.glob(os.path.join(args.checkpoint, "**",
+                                              "model.*"), recursive=True),
+                       key=os.path.getmtime)
+        if not cands:
+            raise SystemExit(f"no model.* snapshot under {args.checkpoint}")
+        model = serializer.load_module(cands[-1])
+    else:
+        raise SystemExit("test needs --model-snapshot or --checkpoint")
+    x, y = _load_data(args.model, args.folder, "test")
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+    res = optim.Evaluator(model).evaluate(
+        samples, [optim.Top1Accuracy(), optim.Top5Accuracy()],
+        batch_size=args.batch_size)
+    for r, m in res:
+        print(f"{m}: {r}")
+
+
+def cmd_perf(args) -> None:
+    """LocalOptimizerPerf protocol: synthetic data, records/sec."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.parallel.train_step import TrainStep
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(0)
+    num_classes = args.num_classes or 1000
+    model = _build_model(args.model, num_classes)
+    shape = {"lenet": (1, 28, 28), "autoencoder": (1, 28, 28)}.get(
+        args.model, (3, 224, 224))
+    if args.model in ("vgg_cifar", "resnet"):
+        shape = (3, 32, 32)
+    step = TrainStep(model, nn.ClassNLLCriterion(),
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16 if args.bf16 else None)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(args.batch_size,) + shape)
+                    .astype(np.float32))
+    y = jnp.asarray(rng.integers(0, num_classes, args.batch_size))
+    loss = None
+    for i in range(args.warmup):
+        loss = step.run(x, y, jax.random.key(i))
+    if loss is not None:
+        float(loss)
+    t0 = time.perf_counter()
+    for i in range(args.iteration):
+        loss = step.run(x, y, jax.random.key(100 + i))
+    float(loss)
+    wall = time.perf_counter() - t0
+    rate = args.batch_size * args.iteration / wall
+    print(f"{args.model}: {rate:.1f} records/sec "
+          f"(batch {args.batch_size}, {args.iteration} iters, "
+          f"{wall:.2f}s)")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="bigdl_tpu.models.cli",
+                                description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--model", default="lenet")
+        sp.add_argument("-f", "--folder", default=None,
+                        help="dataset folder (synthetic data when absent)")
+        sp.add_argument("-b", "--batch-size", type=int, default=64)
+        sp.add_argument("--num-classes", type=int, default=0)
+
+    t = sub.add_parser("train", help="train a zoo model")
+    common(t)
+    t.add_argument("--learning-rate", type=float, default=0.05)
+    t.add_argument("--momentum", type=float, default=0.9)
+    t.add_argument("--weight-decay", type=float, default=0.0)
+    t.add_argument("--max-epoch", type=int, default=2)
+    t.add_argument("--checkpoint", default=None)
+    t.add_argument("--summary-dir", default=None)
+    t.add_argument("--app-name", default="bigdl_tpu")
+    t.add_argument("--model-snapshot", default=None,
+                   help="resume model from snapshot")
+    t.add_argument("--state-snapshot", default=None,
+                   help="resume optim method from snapshot")
+    t.add_argument("--seed", type=int, default=42)
+    t.set_defaults(fn=cmd_train)
+
+    te = sub.add_parser("test", help="evaluate a checkpointed model")
+    common(te)
+    te.add_argument("--checkpoint", default=None)
+    te.add_argument("--model-snapshot", default=None)
+    te.set_defaults(fn=cmd_test)
+
+    pf = sub.add_parser("perf", help="synthetic-data throughput harness")
+    common(pf)
+    pf.add_argument("-i", "--iteration", type=int, default=10)
+    pf.add_argument("--warmup", type=int, default=3)
+    pf.add_argument("--bf16", action="store_true", default=True)
+    pf.add_argument("--no-bf16", dest="bf16", action="store_false")
+    pf.set_defaults(fn=cmd_perf)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
