@@ -50,16 +50,20 @@ def main():
 
     # warmup, then drain the async queue with a value round-trip — over a
     # tunneled device a value fetch is the only reliable sync barrier
-    loss = None
     for i in range(warmup):
-        loss = step.run(x, y, jax.random.key(i))
-    if loss is not None:
-        float(loss)
+        step.run(x, y, jax.random.key(i))
+    if warmup:
+        # params-derived fetch: drains the queue INCLUDING the last warmup
+        # iteration's optimizer update (float(loss) would leave it pending)
+        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
 
     t0 = time.perf_counter()
     for i in range(iters):
-        loss = step.run(x, y, jax.random.key(100 + i))
-    float(loss)  # chain end: steps depend on each other via params
+        step.run(x, y, jax.random.key(100 + i))
+    # chain end: fetch a params-derived scalar so the LAST iteration's
+    # optimizer update is forced inside the timed window (loss_i only
+    # depends on params_{i-1}); value-fetch-only sync protocol
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
     wall = time.perf_counter() - t0
 
     images_per_sec = batch * iters / wall

@@ -21,6 +21,7 @@ runnable anywhere.
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
 import time
@@ -44,7 +45,7 @@ def _build_model(name: str, num_classes: int):
         "resnet": lambda: models.build_resnet_cifar(20, num_classes or 10),
         "resnet50": lambda: models.build_resnet(50, num_classes or 1000),
         "autoencoder": lambda: models.build_autoencoder(),
-        "lstm": lambda: models.build_lstm_classifier(5000,
+        "lstm": lambda: models.build_lstm_classifier(LSTM_VOCAB,
                                                      class_num=num_classes
                                                      or 2),
         "transformer": lambda: models.build_transformer_lm(
@@ -56,10 +57,77 @@ def _build_model(name: str, num_classes: int):
     return builders[name]()
 
 
-def _load_data(model_name: str, folder: Optional[str], split: str
-               ) -> Tuple[np.ndarray, np.ndarray]:
+#: sequence models take [batch, time] int token ids, not images.
+SEQ_MODELS = ("lstm", "transformer")
+LSTM_VOCAB = 5000
+LM_SEQ_LEN = 128
+
+
+@functools.lru_cache(maxsize=2)
+def _news20_corpus(folder: Optional[str], vocab_size: int):
+    """(dictionary, [per-doc token lists], [labels]) for news20 — cached so
+    cmd_train's two _load_data calls read/tokenize the corpus once.
+
+    The vocabulary always comes from the TRAIN split so train/test token
+    ids agree.  Documents are tokenized one-by-one (a doc that tokenizes
+    to nothing yields an empty list, NOT a dropped row) so tokens stay
+    aligned index-for-index with labels."""
+    from bigdl_tpu.dataset import datasets
+    from bigdl_tpu.dataset.text import Dictionary, SentenceTokenizer
+
+    all_pairs = datasets.load_news20(folder)
+    tok = SentenceTokenizer()
+
+    def tokens_of(text):
+        out = list(tok.apply(iter([text])))
+        return out[0] if out else []
+
+    docs = [tokens_of(t) for t, _ in all_pairs]
+    labels = [lab for _, lab in all_pairs]
+    # Dictionary keeps vocab_size words + an UNK row, and ids are shifted
+    # by 1 to reserve 0 for padding, so cap at vocab_size - 2 to keep
+    # every id (UNK included) < vocab_size
+    dic = Dictionary((d for i, d in enumerate(docs) if i % 5 != 4),
+                     vocab_size=max(1, vocab_size - 2))
+    return dic, docs, labels
+
+
+def _load_token_data(model_name: str, folder: Optional[str], split: str,
+                     vocab_size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Token-shaped data for the sequence models: news20 text run through
+    the text pipeline (tokenize -> dictionary -> fixed-length ids).
+
+    ``lstm``  -> (tokens [N,200] int, class labels [N]);
+    ``transformer`` -> (tokens [N,T] int, next-token targets [N,T])."""
+    dic, docs, labels = _news20_corpus(folder, vocab_size)
+    # deterministic split: every 5th doc is test, the rest train
+    keep = [i for i in range(len(docs))
+            if (i % 5 == 4) == (split == "test")]
+    ids = [np.asarray([dic.index(w) + 1 for w in docs[i]], np.int32)
+           for i in keep]  # reserve 0 for padding
+    if model_name == "lstm":
+        seq_len = 200
+        x = np.zeros((len(ids), seq_len), np.int32)
+        for i, t in enumerate(ids):
+            x[i, :min(len(t), seq_len)] = t[:seq_len]
+        y = np.asarray([labels[i] for i in keep], np.int64)
+        return x, y
+    # transformer LM: one long stream chunked into next-token windows
+    stream = np.concatenate(ids) if ids else np.zeros(0, np.int32)
+    n = max(1, len(stream) // (LM_SEQ_LEN + 1))
+    stream = np.resize(stream, n * (LM_SEQ_LEN + 1))
+    chunks = stream.reshape(n, LM_SEQ_LEN + 1)
+    return chunks[:, :-1].astype(np.int32), chunks[:, 1:].astype(np.int64)
+
+
+def _load_data(model_name: str, folder: Optional[str], split: str,
+               num_classes: int = 0) -> Tuple[np.ndarray, np.ndarray]:
     from bigdl_tpu.dataset import datasets
 
+    if model_name in SEQ_MODELS:
+        vocab = (LSTM_VOCAB if model_name == "lstm"
+                 else (num_classes or 256))
+        return _load_token_data(model_name, folder, split, vocab)
     if model_name in ("lenet", "autoencoder"):
         imgs, labels = datasets.load_mnist(folder, split)
         x = ((imgs.astype(np.float32) / 255.0) - 0.1307) / 0.3081
@@ -81,13 +149,16 @@ def cmd_train(args) -> None:
     from bigdl_tpu.utils.rng import RNG
 
     RNG.set_seed(args.seed)
-    model = _build_model(args.model, args.num_classes)
+    x, y = _load_data(args.model, args.folder, "train", args.num_classes)
+    xt, yt = _load_data(args.model, args.folder, "test", args.num_classes)
+    num_classes = args.num_classes
+    if args.model == "lstm" and not num_classes:
+        num_classes = int(max(y.max(), yt.max())) + 1
+    model = _build_model(args.model, num_classes)
     if args.model_snapshot:
         from bigdl_tpu.utils import serializer
 
         model = serializer.load_module(args.model_snapshot)
-    x, y = _load_data(args.model, args.folder, "train")
-    xt, yt = _load_data(args.model, args.folder, "test")
 
     if args.model == "autoencoder":
         flat = x.reshape(len(x), -1)
@@ -95,6 +166,12 @@ def cmd_train(args) -> None:
         criterion = nn.MSECriterion()
         val_methods = [optim.Loss(nn.MSECriterion())]
         val_samples = samples[:256]
+    elif args.model == "transformer":
+        samples = [Sample(x[i], y[i]) for i in range(len(x))]
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        val_methods = [optim.Loss(
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion()))]
+        val_samples = [Sample(xt[i], yt[i]) for i in range(len(xt))]
     else:
         samples = [Sample(x[i], y[i]) for i in range(len(x))]
         criterion = nn.ClassNLLCriterion()
@@ -124,8 +201,8 @@ def cmd_train(args) -> None:
         o.set_validation_summary(
             ValidationSummary(args.summary_dir, args.app_name))
     trained = o.optimize()
-    res = optim.Evaluator(trained).evaluate(val_samples, val_methods,
-                                            batch_size=args.batch_size)
+    res = optim.Evaluator(trained, batch_size=args.batch_size).evaluate(
+        val_samples, val_methods)
     for r, m in res:
         print(f"final {m}: {r}")
 
@@ -149,11 +226,15 @@ def cmd_test(args) -> None:
         model = serializer.load_module(cands[-1])
     else:
         raise SystemExit("test needs --model-snapshot or --checkpoint")
-    x, y = _load_data(args.model, args.folder, "test")
+    x, y = _load_data(args.model, args.folder, "test", args.num_classes)
     samples = [Sample(x[i], y[i]) for i in range(len(x))]
-    res = optim.Evaluator(model).evaluate(
-        samples, [optim.Top1Accuracy(), optim.Top5Accuracy()],
-        batch_size=args.batch_size)
+    if args.model == "transformer":
+        methods = [optim.Loss(
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion()))]
+    else:
+        methods = [optim.Top1Accuracy(), optim.Top5Accuracy()]
+    res = optim.Evaluator(model, batch_size=args.batch_size).evaluate(
+        samples, methods)
     for r, m in res:
         print(f"{m}: {r}")
 
@@ -169,28 +250,49 @@ def cmd_perf(args) -> None:
     from bigdl_tpu.utils.rng import RNG
 
     RNG.set_seed(0)
-    num_classes = args.num_classes or 1000
+    num_classes = args.num_classes or {"lstm": 2, "transformer": 256}.get(
+        args.model, 1000)
     model = _build_model(args.model, num_classes)
-    shape = {"lenet": (1, 28, 28), "autoencoder": (1, 28, 28)}.get(
-        args.model, (3, 224, 224))
-    if args.model in ("vgg_cifar", "resnet"):
-        shape = (3, 32, 32)
-    step = TrainStep(model, nn.ClassNLLCriterion(),
+    rng = np.random.default_rng(0)
+    criterion = nn.ClassNLLCriterion()
+    if args.model in SEQ_MODELS:
+        if args.model == "lstm":
+            x = rng.integers(0, LSTM_VOCAB, (args.batch_size, 200),
+                             dtype=np.int32)
+            y = rng.integers(0, num_classes, args.batch_size)
+        else:
+            # num_classes doubles as the LM vocab, matching _build_model
+            x = rng.integers(0, num_classes,
+                             (args.batch_size, LM_SEQ_LEN), dtype=np.int32)
+            y = rng.integers(0, num_classes, (args.batch_size, LM_SEQ_LEN))
+            criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        x, y = jnp.asarray(x), jnp.asarray(y)
+    else:
+        shape = {"lenet": (1, 28, 28), "autoencoder": (1, 28, 28)}.get(
+            args.model, (3, 224, 224))
+        if args.model in ("vgg_cifar", "resnet"):
+            shape = (3, 32, 32)
+        x = jnp.asarray(rng.normal(size=(args.batch_size,) + shape)
+                        .astype(np.float32))
+        if args.model == "autoencoder":
+            criterion = nn.MSECriterion()
+            y = x.reshape(args.batch_size, -1)
+        else:
+            y = jnp.asarray(rng.integers(0, num_classes, args.batch_size))
+    step = TrainStep(model, criterion,
                      optim.SGD(learning_rate=0.01, momentum=0.9),
                      compute_dtype=jnp.bfloat16 if args.bf16 else None)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(args.batch_size,) + shape)
-                    .astype(np.float32))
-    y = jnp.asarray(rng.integers(0, num_classes, args.batch_size))
-    loss = None
     for i in range(args.warmup):
-        loss = step.run(x, y, jax.random.key(i))
-    if loss is not None:
-        float(loss)
+        step.run(x, y, jax.random.key(i))
+    if args.warmup:
+        # drain the queue including the last warmup optimizer update
+        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
     t0 = time.perf_counter()
     for i in range(args.iteration):
-        loss = step.run(x, y, jax.random.key(100 + i))
-    float(loss)
+        step.run(x, y, jax.random.key(100 + i))
+    # params-derived fetch forces the LAST iteration's optimizer update
+    # inside the timed window (loss_i only depends on params_{i-1})
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
     wall = time.perf_counter() - t0
     rate = args.batch_size * args.iteration / wall
     print(f"{args.model}: {rate:.1f} records/sec "

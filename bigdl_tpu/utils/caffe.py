@@ -237,6 +237,64 @@ class CaffeLoader:
                         chans[top] = int(dims[1])
         return chans
 
+    def _input_spatial(self) -> Dict[str, Tuple[int, int]]:
+        """(H, W) per declared input blob, when the prototxt gives 4-D
+        dims — needed to size an InnerProduct that has no weight blob
+        (the emitted Sequential flattens C*H*W)."""
+        spatial: Dict[str, Tuple[int, int]] = {}
+        names = _as_list(self.net.get("input"))
+        if names:
+            if "input_shape" in self.net:
+                for nm, sh in zip(names, _as_list(self.net["input_shape"])):
+                    dims = _as_list(sh.get("dim"))
+                    if len(dims) >= 4:
+                        spatial[nm] = (int(dims[2]), int(dims[3]))
+            elif "input_dim" in self.net:
+                dims = _as_list(self.net["input_dim"])
+                for i, nm in enumerate(names):
+                    if 4 * i + 3 < len(dims):
+                        spatial[nm] = (int(dims[4 * i + 2]),
+                                       int(dims[4 * i + 3]))
+        for lay in self._layers():
+            if lay.get("type") == "Input":
+                dims = _as_list(lay.get("input_param", {})
+                                .get("shape", {}).get("dim"))
+                if len(dims) >= 4:
+                    for top in _as_list(lay.get("top")):
+                        spatial[top] = (int(dims[2]), int(dims[3]))
+        return spatial
+
+    def _out_spatial(self, lay: Dict,
+                     hw: Optional[Tuple[int, int]]
+                     ) -> Optional[Tuple[int, int]]:
+        """Propagate (H, W) through one layer; None when unknown."""
+        t = str(lay.get("type"))
+        if t in ("InnerProduct", "14"):
+            return (1, 1)
+        if hw is None:
+            return None
+        if t in ("Convolution", "Deconvolution", "4", "39"):
+            p = lay.get("convolution_param", {})
+        elif t in ("Pooling", "17"):
+            p = lay.get("pooling_param", {})
+            if bool(p.get("global_pooling", False)):
+                return (1, 1)
+        else:
+            return hw
+        kh, kw = _pair(p, "kernel_size")
+        dh, dw = _pair(p, "stride", 1)
+        ph, pw_ = _pair(p, "pad", 0)
+        if t in ("Deconvolution", "39"):
+            return ((hw[0] - 1) * dh - 2 * ph + kh,
+                    (hw[1] - 1) * dw - 2 * pw_ + kw)
+        if t in ("Pooling", "17"):  # caffe pooling rounds up (ceil mode)
+            from bigdl_tpu.nn.layers.pooling import _pool_out_size
+
+            return (_pool_out_size(hw[0], kh, dh, ph, ceil_mode=True),
+                    _pool_out_size(hw[1], kw, dw, pw_, ceil_mode=True))
+        return ((hw[0] + 2 * ph - kh) // dh + 1,
+                (hw[1] + 2 * pw_ - kw) // dw + 1)
+
     def _layers(self) -> List[Dict]:
         return _as_list(self.net.get("layer")) + _as_list(
             self.net.get("layers"))
@@ -249,7 +307,8 @@ class CaffeLoader:
         return False
 
     # -- conversion --------------------------------------------------------
-    def _convert(self, lay: Dict, in_channels: Optional[int]):
+    def _convert(self, lay: Dict, in_channels: Optional[int],
+                 in_spatial: Optional[Tuple[int, int]] = None):
         """Return a module or None (passthrough/skip)."""
         import bigdl_tpu.nn as nn
 
@@ -295,7 +354,10 @@ class CaffeLoader:
                 weight = w[0].reshape(n_out, -1)
                 n_in = weight.shape[1]
             elif in_channels is not None:
-                n_in = in_channels
+                # no weight blob: the Linear follows a C*H*W flatten, so
+                # fold the tracked spatial extent into the input size
+                n_in = (in_channels * in_spatial[0] * in_spatial[1]
+                        if in_spatial is not None else in_channels)
                 weight = None
             else:
                 raise ValueError(f"cannot infer input size for {name}")
@@ -409,6 +471,8 @@ class CaffeLoader:
         chans = self._input_channels()
         produced: Dict[str, Node] = {}
         channels: Dict[str, Optional[int]] = dict(chans)
+        spatial: Dict[str, Optional[Tuple[int, int]]] = dict(
+            self._input_spatial())
         inputs: Dict[str, Node] = {}
 
         def blob_node(bname: str) -> Node:
@@ -426,7 +490,9 @@ class CaffeLoader:
             tops = _as_list(lay.get("top"))
             name = lay.get("name", tops[0] if tops else "?")
             in_ch = channels.get(bottoms[0]) if bottoms else None
-            mod, out_ch = self._convert(lay, in_ch)
+            in_hw = spatial.get(bottoms[0]) if bottoms else None
+            mod, out_ch = self._convert(lay, in_ch, in_hw)
+            out_hw = self._out_spatial(lay, in_hw)
             if mod is None:  # data/input/accuracy layer
                 for tpn in tops:
                     if tpn in chans or not bottoms:
@@ -454,6 +520,7 @@ class CaffeLoader:
             for tpn in tops:
                 produced[tpn] = node
                 channels[tpn] = out_ch
+                spatial[tpn] = out_hw
 
         outputs = [produced[b] for b in produced
                    if b not in consumed and produced[b] not in

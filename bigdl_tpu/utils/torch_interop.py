@@ -265,10 +265,13 @@ def to_torch(module) -> Any:
         return tnn.Tanh()
     if isinstance(module, nn.Sigmoid):
         return tnn.Sigmoid()
-    if isinstance(module, nn.SoftMax):
-        return tnn.Softmax(dim=-1)
-    if isinstance(module, nn.LogSoftMax):
-        return tnn.LogSoftmax(dim=-1)
+    if isinstance(module, (nn.SoftMax, nn.LogSoftMax)):
+        # axis=None means "dim 1 for ndim>=2, dim 0 for 1-D" on our side;
+        # torch needs one static dim, so export the ndim>=2 meaning (dim=1)
+        # and keep explicit axes verbatim.
+        dim = module.axis if module.axis is not None else 1
+        return (tnn.Softmax(dim=dim) if isinstance(module, nn.SoftMax)
+                else tnn.LogSoftmax(dim=dim))
     if isinstance(module, nn.Identity):
         return tnn.Identity()
     if isinstance(module, nn.InferReshape) and module.size == (0, -1):

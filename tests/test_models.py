@@ -150,3 +150,24 @@ def test_transformer_lm_trains_with_sequence_parallel_mesh():
     losses = [float(step.run(tokens, tokens, jax.random.key(i)))
               for i in range(8)]
     assert losses[-1] < losses[0]
+
+
+def test_cli_perf_sequence_models(capsys):
+    """ADVICE r1: cmd_perf must feed token-shaped data to lstm/transformer."""
+    from bigdl_tpu.models import cli
+
+    cli.main(["perf", "--model", "lstm", "-b", "2", "-i", "1",
+              "--warmup", "1", "--no-bf16"])
+    assert "records/sec" in capsys.readouterr().out
+    cli.main(["perf", "--model", "transformer", "-b", "2", "-i", "1",
+              "--warmup", "1", "--no-bf16"])
+    assert "records/sec" in capsys.readouterr().out
+
+
+def test_cli_token_data_shapes():
+    from bigdl_tpu.models import cli
+
+    x, y = cli._load_data("lstm", None, "train")
+    assert x.ndim == 2 and x.dtype.kind == "i" and len(x) == len(y)
+    xt, yt = cli._load_data("transformer", None, "test")
+    assert xt.shape == yt.shape and xt.shape[1] == cli.LM_SEQ_LEN
