@@ -1,14 +1,20 @@
 #!/usr/bin/env python
-"""Benchmark driver: Inception-v1 synthetic-ImageNet training throughput on
-the local accelerator — the reference's benchmark protocol
+"""Benchmark driver: the five BASELINE.md configs (LeNet-5/MNIST,
+VGG-16/CIFAR-10, Inception-v1/ImageNet, LSTM text classifier,
+ResNet-50/ImageNet) under the reference's synthetic-data protocol
 (``models/utils/DistriOptimizerPerf.scala:33-124`` / LocalOptimizerPerf:
-synthetic data, fixed batch, records/sec after warmup) on the north-star
-model from BASELINE.json.
+device-resident synthetic data, fixed batch, records/sec after warmup),
+plus an efficiency account: per-step FLOPs from XLA's cost analysis,
+achieved TFLOP/s, and MFU against the chip's peak.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no numeric baseline (BASELINE.json "published": {}),
-so vs_baseline is reported against the reference's qualitative claim anchor:
-null.
+Prints ONE JSON line: the headline metric (Inception-v1 ImageNet
+throughput, the BASELINE.json north star) with a ``configs`` field
+carrying every config's images/sec + FLOPs + TFLOP/s + MFU.
+The reference publishes no numeric baselines (BASELINE.json
+``"published": {}``), so vs_baseline is null.
+
+Env knobs: BENCH_CONFIGS=comma,list  BENCH_ITERS / BENCH_WARMUP,
+BENCH_PEAK_TFLOPS (override the per-chip peak table).
 """
 
 import json
@@ -22,57 +28,154 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+HEADLINE = "inception_v1_imagenet"
 
-def main():
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
-    iters = int(os.environ.get("BENCH_ITERS", "24"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "8"))
+#: peak dense bf16 TFLOP/s per chip (public spec sheets)
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v4": 275.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6e": 918.0,
+    "TPU v6 lite": 918.0,
+    "TPU v7": 4614.0,
+}
 
+
+def _configs():
+    """name -> (build_model, build_batch, criterion, batch)."""
     from bigdl_tpu import models
     import bigdl_tpu.nn as nn
+
+    rng = np.random.default_rng(0)
+
+    def img(batch, c, h, w, classes):
+        x = jnp.asarray(rng.normal(size=(batch, c, h, w)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, classes, batch))
+        return x, y
+
+    def tokens(batch, seq, vocab, classes):
+        x = jnp.asarray(rng.integers(0, vocab, (batch, seq), dtype=np.int32))
+        y = jnp.asarray(rng.integers(0, classes, batch))
+        return x, y
+
+    return {
+        "lenet_mnist": (
+            lambda: models.build_lenet5(10),
+            lambda b: img(b, 1, 28, 28, 10), nn.ClassNLLCriterion(), 1024),
+        "vgg16_cifar10": (
+            lambda: models.build_vgg_for_cifar10(10),
+            lambda b: img(b, 3, 32, 32, 10), nn.ClassNLLCriterion(), 512),
+        "inception_v1_imagenet": (
+            lambda: models.build_inception_v1(1000),
+            lambda b: img(b, 3, 224, 224, 1000), nn.ClassNLLCriterion(), 256),
+        "lstm_text": (
+            lambda: models.build_lstm_classifier(5000, class_num=20),
+            lambda b: tokens(b, 200, 5000, 20), nn.ClassNLLCriterion(), 256),
+        "resnet50_imagenet": (
+            lambda: models.build_resnet(50, 1000),
+            lambda b: img(b, 3, 224, 224, 1000), nn.ClassNLLCriterion(), 128),
+    }
+
+
+def peak_flops_per_sec():
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        return float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
+    kind = jax.devices()[0].device_kind
+    for name, peak in PEAK_TFLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak * 1e12
+    return None
+
+
+def run_config(name, build_model, build_batch, criterion, batch,
+               iters, warmup):
     import bigdl_tpu.optim as optim
     from bigdl_tpu.parallel.train_step import TrainStep
-
     from bigdl_tpu.utils.rng import RNG
 
     RNG.set_seed(0)
-    model = models.build_inception_v1(1000)
-    crit = nn.ClassNLLCriterion()
-    step = TrainStep(model, crit, optim.SGD(learning_rate=0.01, momentum=0.9),
+    model = build_model()
+    step = TrainStep(model, criterion,
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
                      compute_dtype=jnp.bfloat16)
+    x, y = build_batch(batch)
 
-    rng = np.random.default_rng(0)
-    # device-resident batch: the protocol measures training compute, not
-    # host->device transfer (the reference's synthetic-data perf harness
-    # likewise keeps data in memory)
-    x = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
-    y = jnp.asarray(rng.integers(0, 1000, batch))
+    # AOT-compile the step ONCE and install the executable as the step's
+    # compiled fn — the same compile serves both cost analysis and the
+    # timed loop (a separate .lower().compile() would compile twice)
+    flops = None
+    try:
+        compiled = step._build().lower(
+            step.params, step.opt_state, step.buffers, x, y,
+            jax.random.key(0)).compile()
+        step._compiled = compiled
+        cost = compiled.cost_analysis()
+        if cost and cost.get("flops"):
+            flops = float(cost["flops"])
+    except Exception:
+        pass  # step.run falls back to plain jit dispatch
 
-    # warmup, then drain the async queue with a value round-trip — over a
-    # tunneled device a value fetch is the only reliable sync barrier
+    def drain():
+        # value-fetch sync: a params-derived scalar forces every queued
+        # iteration INCLUDING its optimizer update (loss_i alone only
+        # depends on params_{i-1})
+        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+
     for i in range(warmup):
         step.run(x, y, jax.random.key(i))
-    if warmup:
-        # params-derived fetch: drains the queue INCLUDING the last warmup
-        # iteration's optimizer update (float(loss) would leave it pending)
-        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    drain()
 
     t0 = time.perf_counter()
     for i in range(iters):
         step.run(x, y, jax.random.key(100 + i))
-    # chain end: fetch a params-derived scalar so the LAST iteration's
-    # optimizer update is forced inside the timed window (loss_i only
-    # depends on params_{i-1}); value-fetch-only sync protocol
-    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    drain()
     wall = time.perf_counter() - t0
 
-    images_per_sec = batch * iters / wall
-    print(json.dumps({
-        "metric": "inception_v1_imagenet_train_throughput",
-        "value": round(images_per_sec, 2),
+    rate = batch * iters / wall
+    out = {"images_per_sec": round(rate, 2), "batch": batch}
+    if flops:
+        achieved = flops * iters / wall
+        out["step_gflops"] = round(flops / 1e9, 2)
+        out["achieved_tflops"] = round(achieved / 1e12, 2)
+        peak = peak_flops_per_sec()
+        if peak:
+            out["mfu"] = round(achieved / peak, 4)
+    return out
+
+
+def main():
+    iters = int(os.environ.get("BENCH_ITERS", "24"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "8"))
+    cfgs = _configs()
+    only = os.environ.get("BENCH_CONFIGS")
+    names = [n.strip() for n in only.split(",")] if only else list(cfgs)
+
+    results = {}
+    for name in names:
+        try:
+            build_model, build_batch, criterion, batch = cfgs[name]
+            results[name] = run_config(name, build_model, build_batch,
+                                       criterion, batch, iters, warmup)
+        except Exception as e:  # noqa: BLE001 — one config must not sink the rest
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"# {name}: {results[name]}", file=sys.stderr, flush=True)
+
+    # the metric name must say what was actually measured: the north-star
+    # Inception config when it ran, else the first selected config
+    head_name = HEADLINE if HEADLINE in results else next(iter(results))
+    head = results[head_name]
+    line = {
+        "metric": f"{head_name}_train_throughput",
+        "value": head.get("images_per_sec"),
         "unit": "images/sec",
         "vs_baseline": None,
-    }))
+        "mfu": head.get("mfu"),
+        "device": jax.devices()[0].device_kind,
+        "configs": results,
+    }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

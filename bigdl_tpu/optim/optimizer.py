@@ -64,7 +64,21 @@ class Optimizer:
         if isinstance(dataset, (list, tuple)):
             if batch_size is None:
                 raise ValueError("batch_size required when passing raw samples")
-            dataset = DataSet.array(list(dataset)).transform(SampleToMiniBatch(batch_size))
+            # multi-host: each process keeps 1/N of the records and batches
+            # its LOCAL share of the global batch (the reference's
+            # one-cached-partition-per-node layout, DataSet.scala:164-240)
+            nproc, pidx = Engine.process_count(), Engine.process_index()
+            if nproc > 1:
+                if batch_size % nproc != 0:
+                    raise ValueError(
+                        f"global batch_size {batch_size} must divide by the "
+                        f"{nproc} host processes")
+                dataset = DataSet.array(
+                    list(dataset), num_shards=nproc, shard_index=pidx
+                ).transform(SampleToMiniBatch(batch_size // nproc))
+            else:
+                dataset = DataSet.array(list(dataset)).transform(
+                    SampleToMiniBatch(batch_size))
         self.model = model
         self.dataset: AbstractDataSet = dataset
         self.criterion = criterion
@@ -176,10 +190,16 @@ class Optimizer:
             return
         from bigdl_tpu.utils.serializer import save_module, save_optim_method
 
+        # every process participates in the gathers (collectives on a
+        # multi-host mesh); only the coordinator writes files —
+        # single-writer-safe checkpointing
         step.sync_to_model()
         n = self.state["neval"]
         self.optim_method.state["driver_state"] = dict(self.state)
-        self.optim_method.state["func_state"] = jax.tree.map(np.asarray, step.opt_state)
+        self.optim_method.state["func_state"] = jax.tree.map(
+            np.asarray, step.gather_replicated(step.opt_state))
+        if not Engine.is_coordinator():
+            return
         save_module(self.model, os.path.join(self._ckpt_dir, f"model.{n}"), overwrite=True)
         save_optim_method(self.optim_method,
                           os.path.join(self._ckpt_dir, f"optimMethod.{n}"), overwrite=True)
@@ -279,8 +299,22 @@ class Optimizer:
             step.opt_state = jax.tree.map(
                 lambda a, b: jax.device_put(np.asarray(a), b.sharding) if mesh is not None else jax.numpy.asarray(np.asarray(a)),
                 restored, step.opt_state)
-        eval_step = EvalStep(self.model, mesh=mesh)
-        dataset_size = self.dataset.size()
+        from bigdl_tpu.dataset.dataset import DistributedDataSet
+        from bigdl_tpu.parallel.mesh import mesh_process_count
+
+        # multi-host validation runs process-locally: a pure data-parallel
+        # forward needs no collectives, so each process evaluates the full
+        # validation set and reaches identical results
+        multihost = mesh_process_count(mesh) > 1
+        eval_step = EvalStep(self.model, mesh=None if multihost else mesh)
+        if isinstance(self.dataset, DistributedDataSet):
+            # epoch accounting is GLOBAL so every process flips the epoch
+            # on the same iteration (schedules must stay SPMD-consistent)
+            dataset_size = self.dataset.global_size()
+            record_scale = self.dataset.num_shards
+        else:
+            dataset_size = self.dataset.size()
+            record_scale = 1
         records_this_epoch = self.state.get("records", 0)
         data_iter = self.dataset.data(train=True)
         key0 = jax.random.key(RNG.randint(0, 2**31 - 1))
@@ -296,7 +330,7 @@ class Optimizer:
             loss = step.run(batch.get_input(), batch.get_target(), key)
             loss = float(loss)
             t_end = time.perf_counter()
-            n = batch.size()
+            n = batch.size() * record_scale  # global records this iteration
             self.state["neval"] += 1
             self.state["loss"] = loss
             records_this_epoch += n

@@ -16,8 +16,9 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["make_mesh", "data_sharding", "replicated", "DATA_AXIS",
-           "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
+__all__ = ["make_mesh", "data_sharding", "replicated", "mesh_process_count",
+           "shard_local_batch", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
+           "PIPE_AXIS", "EXPERT_AXIS"]
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -54,3 +55,57 @@ def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     return NamedSharding(mesh, P())
+
+
+def mesh_process_count(mesh) -> int:
+    """Number of host processes the mesh spans (1 = single-host)."""
+    if mesh is None:
+        return 1
+    return len({d.process_index for d in mesh.devices.flat})
+
+
+def _batch_scale(mesh, batch_axes: Sequence[str]) -> int:
+    """global_rows // local_rows for THIS process: how many times larger
+    the global batch dim is than the rows this process feeds.
+
+    The batch dim is split K ways (K = prod of the batch axes' mesh
+    sizes); this process addresses K_p distinct batch-shard positions, so
+    it feeds K_p/K of the global rows.  On a mesh whose batch axes do NOT
+    span processes (e.g. multi-host model/seq parallelism with data=1)
+    K_p == K and every process feeds the full global batch."""
+    import jax
+
+    axes = [mesh.axis_names.index(a) for a in batch_axes]
+    k = 1
+    for a in batch_axes:
+        k *= mesh.shape[a]
+    pid = jax.process_index()
+    coords = {tuple(idx[i] for i in axes)
+              for idx in np.ndindex(mesh.devices.shape)
+              if mesh.devices[idx].process_index == pid}
+    if k % len(coords) != 0:
+        raise ValueError(
+            f"batch axes {batch_axes} split {k} ways but this process "
+            f"addresses {len(coords)} positions — uneven process layout")
+    return k // len(coords)
+
+
+def shard_local_batch(mesh, local, batch_axes: Sequence[str] = (DATA_AXIS,)):
+    """Place one process's shard of the global batch onto the mesh.
+
+    Single-host: plain ``device_put`` of the (already global) batch.
+    Multi-host: each process passes its LOCAL rows and the global array is
+    assembled with ``jax.make_array_from_process_local_data`` — the
+    TPU-native analogue of the reference's one-cached-partition-per-node
+    feeding (``dataset/DataSet.scala:164-240``)."""
+    import jax
+    import jax.numpy as jnp
+
+    sharding = data_sharding(mesh, np.ndim(local), batch_axes)
+    if mesh_process_count(mesh) == 1:
+        return jax.device_put(jnp.asarray(local), sharding)
+    local = np.asarray(local)
+    scale = _batch_scale(mesh, batch_axes)
+    global_shape = (local.shape[0] * scale,) + local.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, local,
+                                                  global_shape)

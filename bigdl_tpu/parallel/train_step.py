@@ -34,7 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.nn.module import Module, functional_call, state_dict, _resolve
-from bigdl_tpu.parallel.mesh import DATA_AXIS, data_sharding, replicated
+from bigdl_tpu.parallel.mesh import (DATA_AXIS, data_sharding,
+                                     mesh_process_count, replicated,
+                                     shard_local_batch)
 
 __all__ = ["TrainStep", "bf16_truncate", "EvalStep"]
 
@@ -207,12 +209,15 @@ class TrainStep:
 
     # -- host API ----------------------------------------------------------
     def run(self, x, y, key) -> float:
-        """One training iteration on a global batch; returns the loss."""
+        """One training iteration; returns the loss.
+
+        Single-host callers pass the GLOBAL batch; multi-host callers pass
+        this process's LOCAL shard of it (per-process data sharding, the
+        reference's per-node partition feeding)."""
         if self._compiled is None:
             self._compiled = self._build()
         if self.mesh is not None:
-            shard = lambda a: jax.device_put(
-                jnp.asarray(a), data_sharding(self.mesh, np.ndim(a), self.batch_axes))
+            shard = lambda a: shard_local_batch(self.mesh, a, self.batch_axes)
             x = jax.tree.map(shard, x)
             y = jax.tree.map(shard, y)
         else:
@@ -222,12 +227,24 @@ class TrainStep:
             self.params, self.opt_state, self.buffers, x, y, key)
         return loss
 
+    def gather_replicated(self, tree):
+        """All-gather cross-process-sharded leaves to replicated (no-op on
+        a single-host mesh).  Every process of a multi-host mesh must call
+        this — it compiles to a collective; afterwards each leaf is
+        addressable everywhere (the reference's getModel reassembly
+        crossing the network, ``DistriOptimizer.scala:689-719``)."""
+        if self.mesh is not None and mesh_process_count(self.mesh) > 1:
+            tree = jax.jit(lambda t: t,
+                           out_shardings=replicated(self.mesh))(tree)
+        return tree
+
     def sync_to_model(self):
         """Write the current params/buffers back into the module tree (the
         reference's getModel reassembly, ``DistriOptimizer.scala:689-719``)."""
         from bigdl_tpu.nn.module import load_state_dict
 
-        load_state_dict(self.model, {**self.params, **self.buffers}, strict=False)
+        state = self.gather_replicated({**self.params, **self.buffers})
+        load_state_dict(self.model, state, strict=False)
 
 
 class EvalStep:

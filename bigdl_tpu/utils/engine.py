@@ -11,6 +11,16 @@ inside XLA, so there is no ``_model`` pool).
 
 Config parity (``Engine.scala:113-154`` system properties): environment
 variables ``BIGDL_*`` replace JVM ``-Dbigdl.*`` properties.
+
+Multi-host runtime (``Engine.scala:93-106,344-418`` capability): where the
+reference's ``Engine.init`` discovers the executor topology from the Spark
+master and coordinates N JVMs, here ``Engine.init`` calls
+``jax.distributed.initialize`` when the coordinator env vars are present —
+``BIGDL_COORDINATOR_ADDRESS`` (host:port), ``BIGDL_NUM_PROCESSES``,
+``BIGDL_PROCESS_ID`` — and builds the **global** mesh over every device of
+every process.  Each process then feeds its own shard of the global batch
+(``jax.make_array_from_process_local_data`` inside TrainStep) and XLA's
+collectives ride ICI/DCN; there is no user-level parameter server.
 """
 
 from __future__ import annotations
@@ -36,8 +46,27 @@ class _Engine:
         self._devices = None
         self._node_number = 1
         self._core_number = 1
+        self._process_count = 1
+        self._process_index = 0
+        self._distributed = False
         self._pool: Optional[ThreadPoolExecutor] = None
         self.local_mode = os.environ.get("BIGDL_LOCAL_MODE", "").lower() in ("1", "true")
+
+    # -- multi-host ---------------------------------------------------------
+    def _init_distributed(self):
+        """Join the cluster when coordinator env vars are present — the
+        reference's topology discovery (``Engine.scala:344-418``), with
+        ``jax.distributed`` as the control plane instead of Spark."""
+        import jax
+
+        coord = os.environ.get("BIGDL_COORDINATOR_ADDRESS")
+        if coord is None or self._distributed:
+            return
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=_env_int("BIGDL_NUM_PROCESSES", 1),
+            process_id=_env_int("BIGDL_PROCESS_ID", 0))
+        self._distributed = True
 
     # -- init ---------------------------------------------------------------
     def init(self, devices=None, mesh_shape: Optional[Sequence[int]] = None,
@@ -51,6 +80,7 @@ class _Engine:
         """
         import jax
 
+        self._init_distributed()
         self._devices = list(devices) if devices is not None else jax.devices()
         n = len(self._devices)
         if mesh_shape is None:
@@ -60,7 +90,9 @@ class _Engine:
         from jax.sharding import Mesh
 
         self._mesh = Mesh(arr, tuple(axis_names))
-        self._node_number = _env_int("BIGDL_NODE_NUMBER", n)
+        self._process_count = jax.process_count()
+        self._process_index = jax.process_index()
+        self._node_number = _env_int("BIGDL_NODE_NUMBER", self._process_count)
         self._core_number = _env_int("BIGDL_CORE_NUMBER", os.cpu_count() or 1)
         pool_size = _env_int("BIGDL_DEFAULT_POOL_SIZE", max(4, self._core_number))
         if self._pool is not None:
@@ -95,6 +127,27 @@ class _Engine:
     def device_count(self) -> int:
         self._require_init()
         return len(self._devices)
+
+    def process_count(self) -> int:
+        """Number of host processes in the cluster (the reference's node
+        count, ``Engine.nodeNumber``)."""
+        self._require_init()
+        return self._process_count
+
+    def process_index(self) -> int:
+        """This process's rank; drives per-process data sharding."""
+        self._require_init()
+        return self._process_index
+
+    def is_coordinator(self) -> bool:
+        """True on the single process that owns checkpoint writes."""
+        return self.process_index() == 0
+
+    def local_devices(self):
+        """Devices attached to THIS process (vs the global ``devices``)."""
+        self._require_init()
+        return [d for d in self._devices
+                if d.process_index == self._process_index]
 
     @property
     def default(self) -> ThreadPoolExecutor:

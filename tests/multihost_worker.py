@@ -1,0 +1,100 @@
+"""Worker process for the multi-host equivalence test.
+
+Runs the SAME deterministic training job in two modes:
+- cluster mode: BIGDL_COORDINATOR_ADDRESS/BIGDL_NUM_PROCESSES/
+  BIGDL_PROCESS_ID set -> Engine joins the 2-process CPU cluster, the
+  global mesh spans 2x2=4 virtual devices, and each process feeds its
+  shard of the global batch;
+- single-process control: no coordinator env -> one process, 2 devices.
+
+The coordinator writes the final parameters to BIGDL_TEST_OUT; the test
+asserts both modes converge to the same weights (the reference's
+RefDistriOptimizer equivalence discipline, SURVEY §4).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["BIGDL_REPO"])
+
+import numpy as np  # noqa: E402
+
+from bigdl_tpu.utils.engine import Engine  # noqa: E402
+
+Engine.reset()
+Engine.init()
+
+import bigdl_tpu.nn as nn  # noqa: E402
+import bigdl_tpu.optim as optim  # noqa: E402
+from bigdl_tpu.dataset.sample import Sample  # noqa: E402
+from bigdl_tpu.utils.rng import RNG  # noqa: E402
+
+
+def probe_batch_scale():
+    """Feed-path shapes for non-pure-DP layouts: when the data axis does
+    not span processes (multi-host model/seq parallelism) every process
+    feeds the FULL global batch; when it does, each feeds 1/P."""
+    from bigdl_tpu.parallel.mesh import make_mesh, shard_local_batch
+
+    # data axis size 1 -> model-parallel-only: local rows ARE the batch
+    tp_mesh = make_mesh((1, 4), ("data", "model"), devices=jax.devices())
+    arr = shard_local_batch(tp_mesh, np.ones((6, 3), np.float32))
+    assert arr.shape == (6, 3), arr.shape
+    # data axis across both processes: global batch is 2x the local rows
+    dp_mesh = make_mesh((4, 1), ("data", "model"), devices=jax.devices())
+    arr = shard_local_batch(dp_mesh, np.ones((6, 3), np.float32))
+    assert arr.shape == (12, 3), arr.shape
+    if Engine.is_coordinator():
+        np.savez(os.environ["BIGDL_TEST_OUT"], ok=np.ones(1))
+    print(f"worker {Engine.process_index()}/{Engine.process_count()} done",
+          flush=True)
+
+
+def main():
+    expect_procs = int(os.environ.get("BIGDL_NUM_PROCESSES", "1"))
+    assert Engine.process_count() == expect_procs, (
+        Engine.process_count(), expect_procs)
+    if os.environ.get("BIGDL_TEST_PROBE_SCALE"):
+        probe_batch_scale()
+        return
+
+    RNG.set_seed(7)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                          nn.Linear(16, 4), nn.LogSoftMax())
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = rng.randint(0, 4, 64)
+    samples = [Sample(x[i], y[i]) for i in range(64)]
+
+    # 4 iterations x global batch 16 = exactly one epoch (no shuffle yet),
+    # so cluster and control runs see identical global batch CONTENTS
+    o = optim.Optimizer(model=model, dataset=samples,
+                        criterion=nn.ClassNLLCriterion(), batch_size=16,
+                        end_trigger=optim.Trigger.max_iteration(4))
+    o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+    if os.environ.get("BIGDL_TEST_ZERO1"):
+        o.set_parameter_sync("sharded")
+    ckpt = os.environ.get("BIGDL_TEST_CKPT")
+    if ckpt:
+        o.set_checkpoint(ckpt, optim.Trigger.every_epoch())
+        o.overwrite_checkpoint()
+    trained = o.optimize()
+
+    if Engine.is_coordinator():
+        from bigdl_tpu.nn.module import state_dict
+
+        params = state_dict(trained, kind="param")
+        np.savez(os.environ["BIGDL_TEST_OUT"],
+                 **{k: np.asarray(v) for k, v in params.items()})
+    print(f"worker {Engine.process_index()}/{Engine.process_count()} done",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

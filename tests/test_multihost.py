@@ -1,0 +1,138 @@
+"""Multi-host runtime tests (VERDICT r1 item 1).
+
+The reference coordinates N executors through Spark
+(``utils/Engine.scala:93-106,344-418``); the TPU build joins processes via
+``jax.distributed`` and feeds per-process shards of the global batch.
+These tests spin up a REAL 2-process CPU cluster (each process with 2
+virtual devices -> a 4-device global mesh) and assert it trains to the
+same weights as a single process — the reference's RefDistriOptimizer
+equivalence discipline (SURVEY §4) applied across a process boundary.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(**extra) -> dict:
+    # the worker sets its own XLA_FLAGS/platform before importing jax
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["BIGDL_REPO"] = REPO
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _run_cluster(tmp_path, tag: str, **extra) -> str:
+    """Run the worker on a 2-process cluster; return the coordinator's
+    saved-params path."""
+    port = _free_port()
+    out = str(tmp_path / f"{tag}.npz")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER],
+            env=_worker_env(BIGDL_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                            BIGDL_NUM_PROCESSES=2, BIGDL_PROCESS_ID=pid,
+                            BIGDL_TEST_OUT=out, **extra),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(2)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=420)
+            outputs.append(stdout.decode(errors="replace"))
+    finally:
+        for p in procs:  # a hung collective must not leak live workers
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, text in zip(procs, outputs):
+        assert p.returncode == 0, f"cluster worker failed:\n{text[-4000:]}"
+    assert os.path.exists(out), "coordinator did not write params"
+    return out
+
+
+def _run_single(tmp_path, tag: str, **extra) -> str:
+    out = str(tmp_path / f"{tag}.npz")
+    r = subprocess.run([sys.executable, WORKER],
+                       env=_worker_env(BIGDL_TEST_OUT=out, **extra),
+                       capture_output=True, timeout=420)
+    text = r.stdout.decode(errors="replace") + r.stderr.decode(errors="replace")
+    assert r.returncode == 0, f"single-process worker failed:\n{text[-4000:]}"
+    return out
+
+
+def _assert_same_params(path_a: str, path_b: str):
+    a, b = np.load(path_a), np.load(path_b)
+    assert set(a.files) == set(b.files) and len(a.files) > 0
+    for k in a.files:
+        np.testing.assert_allclose(a[k], b[k], rtol=2e-4, atol=1e-5,
+                                   err_msg=f"param {k} diverged")
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    mp = _run_cluster(tmp_path, "mp")
+    sp = _run_single(tmp_path, "sp")
+    _assert_same_params(mp, sp)
+
+
+def test_two_process_zero1_matches_single_process(tmp_path):
+    """ZeRO-1 optimizer-state sharding across the process boundary."""
+    mp = _run_cluster(tmp_path, "mp_z1", BIGDL_TEST_ZERO1=1)
+    sp = _run_single(tmp_path, "sp_z1")
+    _assert_same_params(mp, sp)
+
+
+def test_two_process_checkpoint_single_writer(tmp_path):
+    """Checkpointing on a cluster: every process participates in the
+    gathers but only the coordinator writes files."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    _run_cluster(tmp_path, "mp_ck", BIGDL_TEST_CKPT=str(ckpt))
+    files = sorted(os.listdir(ckpt))
+    assert any(f.startswith("model.") for f in files), files
+    assert any(f.startswith("optimMethod.") for f in files), files
+
+
+def test_two_process_batch_feed_non_dp_layouts(tmp_path):
+    """shard_local_batch must scale the global batch by how far the DATA
+    axis spans processes, not by the raw process count (a multi-host
+    model-parallel mesh feeds the full batch from every process)."""
+    _run_cluster(tmp_path, "mp_scale", BIGDL_TEST_PROBE_SCALE=1)
+
+
+def test_distributed_dataset_shards_partition():
+    """Per-process shards cover the dataset exactly once."""
+    from bigdl_tpu.dataset.dataset import DistributedDataSet
+
+    data = list(range(10))
+    shards = [DistributedDataSet(data, num_shards=3, shard_index=i)
+              for i in range(3)]
+    seen = sorted(x for s in shards for x in s._data)
+    assert seen == data
+    assert all(s.global_size() == 10 for s in shards)
+
+
+def test_engine_single_process_defaults():
+    from bigdl_tpu.utils.engine import Engine
+
+    assert Engine.process_count() == 1
+    assert Engine.process_index() == 0
+    assert Engine.is_coordinator()
+    assert len(Engine.local_devices()) == Engine.device_count()
