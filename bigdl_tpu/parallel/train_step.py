@@ -102,6 +102,7 @@ class TrainStep:
         self.opt_state = optim_method.init_state(self.params)
         self._meta = _param_meta(model)
         self._compiled = None
+        self._scan_cache = None
         self._place_initial()
 
     # -- sharding ----------------------------------------------------------
@@ -139,7 +140,10 @@ class TrainStep:
             lambda a: jax.device_put(a, self._opt_leaf_sharding(a)), self.opt_state)
 
     # -- the pure step -----------------------------------------------------
-    def _build(self):
+    def _step_fn(self):
+        """The pure (params, opt_state, buffers, x, y, key) -> (params,
+        opt_state, buffers, loss) function, shared by the per-iteration
+        jit and the scan-of-iterations jit."""
         model, criterion, optim = self.model, self.criterion, self.optim
         meta = self._meta
         comp = self.gradient_compression
@@ -205,7 +209,36 @@ class TrainStep:
                     for k, v in new_params.items()}
             return new_params, new_opt, new_buffers, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    def _build(self):
+        return jax.jit(self._step_fn(), donate_argnums=(0, 1, 2))
+
+    def _build_scan(self, n: int, stacked: bool):
+        """n train iterations inside ONE compiled call via ``lax.scan`` —
+        amortizes per-dispatch latency (remote/tunneled devices pay a full
+        round-trip per dispatch) and lets XLA overlap steps.  ``stacked``:
+        x/y carry a leading iteration axis (one minibatch per step);
+        otherwise the same batch repeats (the perf-harness protocol)."""
+        step = self._step_fn()
+
+        def many(params, opt_state, buffers, x, y, key):
+            def body(carry, it):
+                p, o, b = carry
+                if stacked:
+                    i, xi, yi = it
+                else:
+                    i, xi, yi = it, x, y
+                p, o, b, loss = step(p, o, b, xi, yi,
+                                     jax.random.fold_in(key, i))
+                return (p, o, b), loss
+
+            xs = (jnp.arange(n), x, y) if stacked else jnp.arange(n)
+            (params, opt_state, buffers), losses = jax.lax.scan(
+                body, (params, opt_state, buffers), xs)
+            return params, opt_state, buffers, losses
+
+        return jax.jit(many, donate_argnums=(0, 1, 2))
 
     # -- host API ----------------------------------------------------------
     def run(self, x, y, key) -> float:
@@ -216,16 +249,63 @@ class TrainStep:
         reference's per-node partition feeding)."""
         if self._compiled is None:
             self._compiled = self._build()
-        if self.mesh is not None:
-            shard = lambda a: shard_local_batch(self.mesh, a, self.batch_axes)
-            x = jax.tree.map(shard, x)
-            y = jax.tree.map(shard, y)
-        else:
-            x = jax.tree.map(jnp.asarray, x)
-            y = jax.tree.map(jnp.asarray, y)
+        x, y = self._shard_batch(x, y)
         self.params, self.opt_state, self.buffers, loss = self._compiled(
             self.params, self.opt_state, self.buffers, x, y, key)
         return loss
+
+    def _shard_batch(self, x, y, stacked: bool = False):
+        if self.mesh is None:
+            return jax.tree.map(jnp.asarray, x), jax.tree.map(jnp.asarray, y)
+        if not stacked:
+            shard = lambda a: shard_local_batch(self.mesh, a, self.batch_axes)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from bigdl_tpu.parallel.mesh import _batch_scale
+
+            ax = self.batch_axes[0] if len(self.batch_axes) == 1 \
+                else tuple(self.batch_axes)
+            multihost = mesh_process_count(self.mesh) > 1
+
+            def shard(a):  # leading axis is ITERATION; batch is axis 1
+                spec = [None] * np.ndim(a)
+                if np.ndim(a) >= 2:
+                    spec[1] = ax
+                sharding = NamedSharding(self.mesh, P(*spec))
+                if not multihost:
+                    return jax.device_put(jnp.asarray(a), sharding)
+                # multi-host: a is this process's LOCAL rows on axis 1
+                local = np.asarray(a)
+                scale = _batch_scale(self.mesh, self.batch_axes)
+                gshape = (local.shape[0], local.shape[1] * scale) \
+                    + local.shape[2:]
+                return jax.make_array_from_process_local_data(
+                    sharding, local, gshape)
+        return jax.tree.map(shard, x), jax.tree.map(shard, y)
+
+    def run_scan(self, x, y, key, n: int, stacked: bool = False):
+        """Run ``n`` training iterations in one dispatch; returns the
+        per-iteration losses (device array).  See ``_build_scan``."""
+        cache_key = (n, stacked)
+        if getattr(self, "_scan_cache", None) is None \
+                or self._scan_cache[0] != cache_key:
+            self._scan_cache = (cache_key, self._build_scan(n, stacked))
+        x, y = self._shard_batch(x, y, stacked)
+        self.params, self.opt_state, self.buffers, losses = \
+            self._scan_cache[1](self.params, self.opt_state, self.buffers,
+                                x, y, key)
+        return losses
+
+    def aot_scan(self, x, y, key, n: int, stacked: bool = False):
+        """AOT-compile the scan-of-n-steps once; installs the executable
+        for ``run_scan`` and returns its XLA cost analysis (the scan BODY
+        is counted once — multiply flops by n for totals)."""
+        x, y = self._shard_batch(x, y, stacked)
+        compiled = self._build_scan(n, stacked).lower(
+            self.params, self.opt_state, self.buffers, x, y, key).compile()
+        self._scan_cache = ((n, stacked), compiled)
+        return compiled.cost_analysis()
 
     def gather_replicated(self, tree):
         """All-gather cross-process-sharded leaves to replicated (no-op on

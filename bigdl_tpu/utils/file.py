@@ -1,14 +1,18 @@
-"""Object persistence (``utils/File.scala:25``: save/load to local FS,
+"""Byte-blob persistence (``utils/File.scala:25``: save/load to local FS,
 HDFS, S3).  TPU-native equivalent: local FS + GCS-style ``gs://`` via
 fsspec when available (gated — zero-egress environments fall back to a
-clear error), with atomic local writes."""
+clear error), with atomic local writes.
+
+Unlike the reference (Java serialization), this layer moves OPAQUE BYTES
+only; object encoding is owned by the safe, versioned BTPU format
+(``utils/module_format.py``), so nothing in the IO path can execute code
+on load.
+"""
 
 from __future__ import annotations
 
 import os
-import pickle
 import tempfile
-from typing import Any
 
 __all__ = ["save", "load", "is_remote"]
 
@@ -30,20 +34,24 @@ def _open(path: str, mode: str):
     return open(path, mode)
 
 
-def save(obj: Any, path: str, overwrite: bool = False):
-    """(``File.save``) — atomic for local paths."""
+def save(data: bytes, path: str, overwrite: bool = False):
+    """(``File.save``) — atomic for local paths; raw bytes only."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise TypeError(
+            f"File.save moves bytes, got {type(data).__name__}; encode "
+            f"objects with utils.module_format first")
     if not overwrite and _exists(path):
         raise FileExistsError(f"{path} exists and overwrite=False")
     if is_remote(path):
         with _open(path, "wb") as f:
-            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(data)
         return
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(data)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -51,9 +59,9 @@ def save(obj: Any, path: str, overwrite: bool = False):
         raise
 
 
-def load(path: str) -> Any:
+def load(path: str) -> bytes:
     with _open(path, "rb") as f:
-        return pickle.load(f)
+        return f.read()
 
 
 def _exists(path: str) -> bool:

@@ -1,0 +1,337 @@
+"""BTPU — the versioned, safe module/optim persistence format (SURVEY
+§2.9; ``utils/serializer/ModuleSerializer.scala:34`` +
+``resources/serialization/bigdl.proto``).
+
+The reference serializes modules to a schema'd protobuf (BigDLModule /
+BigDLTensor / AttrValue) through a registry keyed by class name, so a
+file can be loaded without executing arbitrary code and old files fail
+cleanly.  This module is the TPU build's equivalent:
+
+- **wire layout** (via ``utils/protowire``): ``b"BTPU"`` magic, a format
+  version varint, then protobuf-style fields — header JSON, structure
+  JSON, and one length-delimited record per tensor (dtype/shape JSON +
+  raw little-endian bytes).
+- **structure**: a JSON document describing the object graph.  Objects
+  are recorded as ``{"__t__": "obj", "c": <class name>, ...}`` and
+  resolved against a REGISTRY of classes defined inside ``bigdl_tpu``
+  (modules, criterions, optim methods, schedules, regularizers, graph
+  nodes) — never by unpickling, so loading a file cannot execute
+  attacker-controlled code.
+- **sharing & cycles**: every object gets a memo id at first visit;
+  later visits emit ``{"__t__": "ref"}``, preserving shared weights and
+  the (possibly cyclic) Graph node topology.
+- **versioning**: unknown format versions and unregistered class names
+  are rejected with a clear error instead of a best-effort parse.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import protowire
+
+__all__ = ["dumps", "loads", "SerializationError", "register",
+           "FORMAT_VERSION", "MAGIC"]
+
+MAGIC = b"BTPU"
+FORMAT_VERSION = 1
+
+#: modules scanned for serializable classes (class name -> class).
+_SCAN_MODULES = (
+    "bigdl_tpu.nn",
+    "bigdl_tpu.nn.module",
+    "bigdl_tpu.nn.graph",
+    "bigdl_tpu.nn.init",
+    "bigdl_tpu.nn.criterion",
+    "bigdl_tpu.optim.optim_method",
+    "bigdl_tpu.optim.regularizer",
+    "bigdl_tpu.models.transformer",
+    "bigdl_tpu.models.resnet",
+    "bigdl_tpu.models.inception",
+    "bigdl_tpu.models.vgg",
+    "bigdl_tpu.models.lenet",
+    "bigdl_tpu.ops.control",
+)
+
+_DTYPES = ("float32", "float64", "float16", "bfloat16", "int8", "int16",
+           "int32", "int64", "uint8", "uint16", "uint32", "uint64", "bool")
+
+
+class SerializationError(Exception):
+    pass
+
+
+_extra_registry: Dict[str, type] = {}
+_registry_cache: Optional[Dict[str, type]] = None
+
+
+def register(cls: type) -> type:
+    """Register a user-defined class for BTPU persistence (the
+    reference's ``ModuleSerializer.registerModule``)."""
+    global _registry_cache
+    _extra_registry[cls.__name__] = cls
+    _registry_cache = None
+    return cls
+
+
+def _registry() -> Dict[str, type]:
+    global _registry_cache
+    if _registry_cache is not None:
+        return _registry_cache
+    reg: Dict[str, type] = {}
+    for modname in _SCAN_MODULES:
+        mod = importlib.import_module(modname)
+        for name, obj in vars(mod).items():
+            if isinstance(obj, type) and obj.__module__.startswith("bigdl_tpu"):
+                reg.setdefault(obj.__name__, obj)
+    reg.update(_extra_registry)
+    _registry_cache = reg
+    return reg
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name not in _DTYPES:
+        raise SerializationError(f"disallowed tensor dtype {name!r}")
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(name)
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+class _Encoder:
+    def __init__(self):
+        self.memo: Dict[int, int] = {}
+        self.next_id = 0
+        self.tensors: List[Tuple[str, Tuple[int, ...], bytes]] = []
+        self.tensor_memo: Dict[int, int] = {}
+        # id()-keyed memos are only sound while the objects stay alive —
+        # CPython reuses addresses of freed temporaries
+        self._keepalive: List[Any] = []
+
+    def tensor(self, arr) -> int:
+        key = id(arr)
+        if key in self.tensor_memo:
+            return self.tensor_memo[key]
+        self._keepalive.append(arr)
+        a = np.asarray(arr)
+        name = a.dtype.name
+        if name not in _DTYPES:
+            raise SerializationError(f"cannot persist dtype {a.dtype}")
+        idx = len(self.tensors)
+        self.tensors.append((name, tuple(a.shape),
+                             np.ascontiguousarray(a).tobytes()))
+        self.tensor_memo[key] = idx
+        return idx
+
+    def value(self, v) -> Any:  # noqa: C901 — one dispatch table
+        import jax
+
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, (np.bool_, np.integer)):
+            return {"__t__": "npscalar", "dtype": v.dtype.name, "v": int(v)}
+        if isinstance(v, np.floating):
+            return {"__t__": "npscalar", "dtype": v.dtype.name, "v": float(v)}
+        if isinstance(v, bytes):
+            import base64
+
+            return {"__t__": "bytes", "v": base64.b64encode(v).decode()}
+        if isinstance(v, (list, tuple, set, frozenset)):
+            kind = {list: "list", tuple: "tuple", set: "set",
+                    frozenset: "frozenset"}[type(v)]
+            return {"__t__": kind, "v": [self.value(x) for x in v]}
+        if isinstance(v, dict):
+            return {"__t__": "dict",
+                    "v": [[self.value(k), self.value(x)]
+                          for k, x in v.items()]}
+        if isinstance(v, jax.Array):
+            if jax.dtypes.issubdtype(v.dtype, jax.dtypes.prng_key):
+                return {"__t__": "prngkey",
+                        "impl": str(jax.random.key_impl(v)),
+                        "i": self.tensor(jax.random.key_data(v))}
+            return {"__t__": "tensor", "i": self.tensor(v), "jax": True}
+        if isinstance(v, np.ndarray):
+            return {"__t__": "tensor", "i": self.tensor(v)}
+        if isinstance(v, np.dtype):
+            return {"__t__": "dtype", "v": v.name}
+        if isinstance(v, type):
+            # dtype-like classes (jnp.bfloat16 is a type) and registered classes
+            if np.issubdtype(v, np.generic) or v.__name__ in _DTYPES:
+                return {"__t__": "dtype", "v": np.dtype(v).name}
+            if _registry().get(v.__name__) is v:
+                return {"__t__": "class", "c": v.__name__}
+            raise SerializationError(f"cannot persist class {v!r}")
+        if callable(v) and hasattr(v, "__module__") and hasattr(v, "__qualname__") \
+                and not isinstance(v, type):
+            m, q = v.__module__ or "", v.__qualname__
+            if m.startswith("bigdl_tpu") and "<" not in q and "." not in q:
+                return {"__t__": "fn", "m": m, "q": q}
+            raise SerializationError(
+                f"cannot persist callable {q} from {m} (only module-level "
+                f"bigdl_tpu functions are serializable)")
+        cls = type(v)
+        if _registry().get(cls.__name__) is cls:
+            if id(v) in self.memo:
+                return {"__t__": "ref", "id": self.memo[id(v)]}
+            oid = self.next_id
+            self.next_id += 1
+            self.memo[id(v)] = oid
+            self._keepalive.append(v)
+            attrs = {k: self.value(x) for k, x in vars(v).items()}
+            return {"__t__": "obj", "c": cls.__name__, "id": oid, "a": attrs}
+        raise SerializationError(
+            f"cannot persist {cls.__module__}.{cls.__name__} — register it "
+            f"with bigdl_tpu.utils.module_format.register")
+
+
+def dumps(obj, kind: str = "module") -> bytes:
+    enc = _Encoder()
+    structure = enc.value(obj)
+    header = {"format": "bigdl_tpu", "kind": kind,
+              "tensors": len(enc.tensors)}
+    out = [MAGIC, protowire.write_varint(FORMAT_VERSION),
+           protowire.emit_bytes(1, json.dumps(header).encode()),
+           protowire.emit_bytes(2, json.dumps(structure).encode())]
+    for dtype, shape, raw in enc.tensors:
+        meta = json.dumps({"dtype": dtype, "shape": list(shape)}).encode()
+        entry = protowire.emit_bytes(1, meta) + protowire.emit_bytes(2, raw)
+        out.append(protowire.emit_bytes(3, entry))
+    return b"".join(out)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+class _Decoder:
+    def __init__(self, tensors: List[np.ndarray]):
+        self.tensors = tensors
+        self.memo: Dict[int, Any] = {}
+
+    def value(self, v) -> Any:  # noqa: C901
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if not isinstance(v, dict):
+            raise SerializationError(f"malformed structure node {v!r}")
+        t = v.get("__t__")
+        if t == "npscalar":
+            return _np_dtype(v["dtype"]).type(v["v"])
+        if t == "bytes":
+            import base64
+
+            return base64.b64decode(v["v"])
+        if t in ("list", "tuple", "set", "frozenset"):
+            items = [self.value(x) for x in v["v"]]
+            return {"list": list, "tuple": tuple, "set": set,
+                    "frozenset": frozenset}[t](items)
+        if t == "dict":
+            return {self.value(k): self.value(x) for k, x in v["v"]}
+        if t == "tensor":
+            arr = self.tensors[self._index(v["i"])]
+            if v.get("jax"):
+                import jax.numpy as jnp
+
+                return jnp.asarray(arr)
+            return arr
+        if t == "prngkey":
+            import jax
+
+            return jax.random.wrap_key_data(
+                jax.numpy.asarray(self.tensors[self._index(v["i"])]),
+                impl=v["impl"])
+        if t == "dtype":
+            return _np_dtype(v["v"])
+        if t == "class":
+            return self._resolve(v["c"])
+        if t == "fn":
+            m = v["m"]
+            if not m.startswith("bigdl_tpu"):
+                raise SerializationError(f"refusing function module {m!r}")
+            fn = getattr(importlib.import_module(m), v["q"], None)
+            if fn is None or not callable(fn):
+                raise SerializationError(f"unknown function {m}:{v['q']}")
+            return fn
+        if t == "obj":
+            cls = self._resolve(v["c"])
+            obj = cls.__new__(cls)
+            self.memo[v["id"]] = obj  # before attrs: cycles resolve to obj
+            for k, x in v["a"].items():
+                obj.__dict__[k] = self.value(x)
+            return obj
+        if t == "ref":
+            if v["id"] not in self.memo:
+                raise SerializationError(f"dangling ref {v['id']}")
+            return self.memo[v["id"]]
+        raise SerializationError(f"unknown structure tag {t!r}")
+
+    def _index(self, i) -> int:
+        if not isinstance(i, int) or not 0 <= i < len(self.tensors):
+            raise SerializationError(f"tensor index {i!r} out of range")
+        return i
+
+    @staticmethod
+    def _resolve(name: str) -> type:
+        cls = _registry().get(name)
+        if cls is None:
+            raise SerializationError(
+                f"unknown class {name!r} — produced by a newer version or "
+                f"an unregistered extension")
+        return cls
+
+
+def loads(blob: bytes, kind: Optional[str] = None):
+    if not blob.startswith(MAGIC):
+        raise SerializationError(
+            "not a BTPU file (bad magic); legacy pickle checkpoints are "
+            "not supported — re-save with the current version")
+    version, pos = protowire.read_varint(blob, len(MAGIC))
+    if version != FORMAT_VERSION:
+        raise SerializationError(
+            f"unsupported BTPU format version {version} "
+            f"(this build reads version {FORMAT_VERSION})")
+    header = structure = None
+    tensors: List[np.ndarray] = []
+    try:
+        for field, wt, val in protowire.fields(blob[pos:]):
+            if field == 1 and wt == 2:
+                header = json.loads(val.decode())
+            elif field == 2 and wt == 2:
+                structure = json.loads(val.decode())
+            elif field == 3 and wt == 2:
+                meta = raw = None
+                for f2, w2, v2 in protowire.fields(val):
+                    if f2 == 1 and w2 == 2:
+                        meta = json.loads(v2.decode())
+                    elif f2 == 2 and w2 == 2:
+                        raw = v2
+                if meta is None or raw is None:
+                    raise SerializationError("malformed tensor record")
+                dt = _np_dtype(meta["dtype"])
+                shape = tuple(int(s) for s in meta["shape"])
+                n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+                if len(raw) != n:
+                    raise SerializationError(
+                        f"tensor byte count {len(raw)} != expected {n}")
+                tensors.append(np.frombuffer(raw, dtype=dt).reshape(shape)
+                               .copy())
+    except (IndexError, struct.error, UnicodeDecodeError,
+            json.JSONDecodeError) as e:
+        raise SerializationError(f"corrupted BTPU file: {e}") from e
+    if header is None or structure is None:
+        raise SerializationError("corrupted BTPU file: missing header/structure")
+    if kind is not None and header.get("kind") != kind:
+        raise SerializationError(
+            f"expected a {kind!r} file, found {header.get('kind')!r}")
+    if header.get("tensors") != len(tensors):
+        raise SerializationError("corrupted BTPU file: tensor count mismatch")
+    return _Decoder(tensors).value(structure)

@@ -2,75 +2,47 @@
 
 The reference has two formats: Java serialization (default checkpoints,
 ``AbstractModule.save`` / ``Module.load``) and a versioned protobuf module
-format (``utils/serializer/*.scala`` + ``bigdl.proto``).  Here:
+format (``utils/serializer/*.scala`` + ``bigdl.proto``).  Here ONE format
+serves both roles: **BTPU** (``utils/module_format.py``) — a versioned,
+registry-driven, no-code-execution-on-load encoding (wire framing via
+``utils/protowire``, class names resolved against the framework's own
+registry, raw little-endian tensors).  Unknown versions and classes are
+rejected cleanly; pickle is not used anywhere.
 
-- **Checkpoint format** (this module): the full module object is pickled
-  with every device array converted to numpy — host-portable, no device
-  state, loadable without model code changes.  Optim methods likewise.
-- **Structured format**: ``save_state_dict``/``load_state_dict_file``
-  persist only ``{path: array}`` (npz), the analogue of weight-only
-  protobuf round-trips, usable across re-implementations of a model.
+``save_state_dict``/``load_state_dict_file`` additionally persist bare
+``{path: array}`` maps (npz) for weight-only interchange.
 """
 
 from __future__ import annotations
 
-import io
-import pickle
 from typing import Any, Dict
 
 import numpy as np
 
 from bigdl_tpu.utils import file as File
+from bigdl_tpu.utils.module_format import (SerializationError, dumps, loads,
+                                           register)
 
 __all__ = [
     "save_module", "load_module", "save_optim_method", "load_optim_method",
-    "save_state_dict", "load_state_dict_file",
+    "save_state_dict", "load_state_dict_file", "SerializationError",
+    "register",
 ]
 
 
-def _to_numpy_tree(obj):
-    import jax
-
-    def conv(x):
-        if isinstance(x, jax.Array):
-            return np.asarray(x)
-        return x
-
-    return jax.tree.map(conv, obj)
-
-
-class _NumpyfyingPickler(pickle.Pickler):
-    def persistent_id(self, obj):
-        return None
-
-    def reducer_override(self, obj):  # numpy-ify jax arrays on the fly
-        import jax
-
-        if isinstance(obj, jax.Array):
-            return (np.asarray, (np.asarray(obj),))
-        return NotImplemented
-
-
-def _dumps(obj) -> bytes:
-    buf = io.BytesIO()
-    _NumpyfyingPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
-    return buf.getvalue()
-
-
 def save_module(module, path: str, overwrite: bool = False):
-    File.save(_dumps(module), path, overwrite)
+    File.save(dumps(module, kind="module"), path, overwrite)
 
 
 def load_module(path: str):
-    blob = File.load(path)
-    module = pickle.loads(blob)
+    module = loads(File.load(path), kind="module")
     _rehydrate(module)
     return module
 
 
 def _rehydrate(module):
-    """numpy arrays -> jnp on first use happens lazily via jnp.asarray in
-    forward paths; convert eagerly for params/buffers so dtypes are exact."""
+    """Params/buffers come back as numpy; convert eagerly to jnp so
+    dtypes are exact before the first forward."""
     import jax.numpy as jnp
 
     from bigdl_tpu.nn.module import Module
@@ -85,11 +57,11 @@ def _rehydrate(module):
 
 
 def save_optim_method(method, path: str, overwrite: bool = False):
-    File.save(_dumps(method), path, overwrite)
+    File.save(dumps(method, kind="optim"), path, overwrite)
 
 
 def load_optim_method(path: str):
-    return pickle.loads(File.load(path))
+    return loads(File.load(path), kind="optim")
 
 
 def save_state_dict(state: Dict[str, Any], path: str, overwrite: bool = False):

@@ -1,0 +1,221 @@
+"""BTPU versioned persistence tests (VERDICT r1 item 5; reference:
+``utils/serializer/ModuleSerializer.scala:34`` + ``bigdl.proto``).
+
+Round-trips, forward-equality after reload, shared-weight preservation,
+checkpoint integration, and the negative paths: corrupted files, future
+format versions, unknown classes, and non-BTPU (legacy pickle) blobs all
+fail with a clean SerializationError — never arbitrary code execution.
+"""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import state_dict
+from bigdl_tpu.utils import module_format as mf
+from bigdl_tpu.utils import serializer
+from bigdl_tpu.utils.rng import RNG
+
+
+def _roundtrip_forward(model, x):
+    m2 = mf.loads(mf.dumps(model))
+    np.testing.assert_allclose(np.asarray(model.evaluate().forward(x)),
+                               np.asarray(m2.evaluate().forward(x)),
+                               rtol=1e-6)
+    return m2
+
+
+def test_mlp_roundtrip_forward_equality():
+    RNG.set_seed(0)
+    model = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Dropout(0.2),
+                          nn.Linear(16, 3), nn.LogSoftMax())
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    _roundtrip_forward(model, x)
+
+
+def test_conv_bn_roundtrip_keeps_buffers():
+    RNG.set_seed(0)
+    model = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                          nn.SpatialBatchNormalization(4), nn.ReLU())
+    # populate BN running stats
+    x = np.random.RandomState(1).randn(2, 3, 5, 5).astype(np.float32)
+    model.training_mode()
+    model.forward(x)
+    m2 = _roundtrip_forward(model, x)
+    sd1, sd2 = state_dict(model), state_dict(m2)
+    assert set(sd1) == set(sd2)
+    for k in sd1:
+        np.testing.assert_array_equal(np.asarray(sd1[k]), np.asarray(sd2[k]))
+
+
+def test_graph_roundtrip():
+    from bigdl_tpu.nn.graph import node_from_module
+
+    RNG.set_seed(0)
+    inp = nn.Input(name="x")
+    h = node_from_module(nn.Linear(8, 8).set_name("fc1"), [inp])
+    r = node_from_module(nn.ReLU().set_name("act"), [h])
+    out = node_from_module(nn.Linear(8, 4).set_name("fc2"), [r])
+    g = nn.Graph([inp], [out])
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    _roundtrip_forward(g, x)
+
+
+def test_model_zoo_roundtrip():
+    from bigdl_tpu import models
+
+    RNG.set_seed(0)
+    for build in (lambda: models.build_lenet5(10),
+                  lambda: models.build_resnet_cifar(8, 10),
+                  lambda: models.build_lstm_classifier(50, 8, 8, 3)):
+        m = build()
+        m2 = mf.loads(mf.dumps(m))
+        sd1, sd2 = state_dict(m), state_dict(m2)
+        assert set(sd1) == set(sd2)
+        for k in sd1:
+            np.testing.assert_array_equal(np.asarray(sd1[k]),
+                                          np.asarray(sd2[k]))
+
+
+def test_optim_method_roundtrip():
+    import bigdl_tpu.optim as optim
+
+    om = optim.Adam(learning_rate=3e-4)
+    om.state["driver_state"] = {"epoch": 3, "neval": 11}
+    om.state["func_state"] = {"step": np.asarray(11),
+                              "m": {"w": np.ones((4, 2), np.float32)}}
+    o2 = mf.loads(mf.dumps(om, kind="optim"), kind="optim")
+    assert type(o2) is optim.Adam
+    assert o2.state["driver_state"] == {"epoch": 3, "neval": 11}
+    np.testing.assert_array_equal(o2.state["func_state"]["m"]["w"],
+                                  np.ones((4, 2), np.float32))
+
+
+def test_shared_weights_stay_shared():
+    RNG.set_seed(0)
+    shared = nn.Linear(5, 5)
+    model = nn.Sequential(shared, nn.ReLU(), shared)
+    m2 = mf.loads(mf.dumps(model))
+    mods = list(m2.modules())
+    layers = [m for m in mods if isinstance(m, nn.Linear)]
+    assert layers[0] is layers[1], "shared module duplicated on reload"
+
+
+def test_serializer_file_roundtrip(tmp_path):
+    RNG.set_seed(0)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    p = str(tmp_path / "m.btpu")
+    serializer.save_module(model, p)
+    m2 = serializer.load_module(p)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(model.forward(x)),
+                               np.asarray(m2.forward(x)), rtol=1e-6)
+
+
+def test_rejects_bad_magic_and_legacy_pickle(tmp_path):
+    import pickle
+
+    blob = pickle.dumps({"x": 1})
+    with pytest.raises(mf.SerializationError, match="magic"):
+        mf.loads(blob)
+    p = tmp_path / "legacy"
+    p.write_bytes(blob)
+    with pytest.raises(mf.SerializationError):
+        serializer.load_module(str(p))
+
+
+def test_rejects_future_version():
+    from bigdl_tpu.utils import protowire
+
+    blob = mf.MAGIC + protowire.write_varint(mf.FORMAT_VERSION + 1)
+    with pytest.raises(mf.SerializationError, match="version"):
+        mf.loads(blob)
+
+
+def test_rejects_corrupted_payload():
+    RNG.set_seed(0)
+    blob = bytearray(mf.dumps(nn.Linear(4, 4)))
+    blob = blob[: len(blob) // 2]  # truncate mid-tensor
+    with pytest.raises(mf.SerializationError):
+        mf.loads(bytes(blob))
+
+
+def test_rejects_unknown_class():
+    import json
+
+    from bigdl_tpu.utils import protowire
+
+    structure = {"__t__": "obj", "c": "TotallyUnknownLayer", "id": 0, "a": {}}
+    header = {"format": "bigdl_tpu", "kind": "module", "tensors": 0}
+    blob = (mf.MAGIC + protowire.write_varint(mf.FORMAT_VERSION)
+            + protowire.emit_bytes(1, json.dumps(header).encode())
+            + protowire.emit_bytes(2, json.dumps(structure).encode()))
+    with pytest.raises(mf.SerializationError, match="unknown class"):
+        mf.loads(blob)
+
+
+def test_rejects_wrong_kind():
+    RNG.set_seed(0)
+    blob = mf.dumps(nn.Linear(2, 2), kind="module")
+    with pytest.raises(mf.SerializationError, match="kind|expected"):
+        mf.loads(blob, kind="optim")
+
+
+def test_no_code_execution_on_load():
+    """A malicious structure naming arbitrary modules/functions must not
+    import or call anything outside bigdl_tpu."""
+    import json
+
+    from bigdl_tpu.utils import protowire
+
+    structure = {"__t__": "fn", "m": "os", "q": "system"}
+    header = {"format": "bigdl_tpu", "kind": "module", "tensors": 0}
+    blob = (mf.MAGIC + protowire.write_varint(mf.FORMAT_VERSION)
+            + protowire.emit_bytes(1, json.dumps(header).encode())
+            + protowire.emit_bytes(2, json.dumps(structure).encode()))
+    with pytest.raises(mf.SerializationError, match="refusing"):
+        mf.loads(blob)
+
+
+def test_register_extension_class():
+    from bigdl_tpu.nn.module import Module, Parameter
+
+    @mf.register
+    class _MyScale(Module):
+        def __init__(self, n):
+            super().__init__()
+            self.weight = Parameter(np.full((n,), 2.0, np.float32))
+
+        def update_output(self, input):
+            return input * self._params["weight"]
+
+    m = _MyScale(3)
+    m2 = mf.loads(mf.dumps(m))
+    np.testing.assert_array_equal(np.asarray(m2._params["weight"]),
+                                  np.full((3,), 2.0, np.float32))
+
+
+def test_file_layer_contract(tmp_path):
+    """utils.file moves opaque bytes only (VERDICT r1 weak #6: the remote
+    path's contract); object encoding lives in module_format."""
+    from bigdl_tpu.utils import file as File
+
+    p = str(tmp_path / "blob.bin")
+    File.save(b"abc", p)
+    assert File.load(p) == b"abc"
+    with pytest.raises(FileExistsError):
+        File.save(b"xyz", p)
+    File.save(b"xyz", p, overwrite=True)
+    assert File.load(p) == b"xyz"
+    with pytest.raises(TypeError, match="bytes"):
+        File.save({"not": "bytes"}, str(tmp_path / "o.bin"))
+    assert File.is_remote("gs://bucket/k") and not File.is_remote(p)
+    # memory:// exercises the fsspec remote branch end-to-end
+    try:
+        import fsspec  # noqa: F401
+
+        File.save(b"remote", "memory://ckpt/blob.bin", overwrite=True)
+        assert File.load("memory://ckpt/blob.bin") == b"remote"
+    except ImportError:
+        with pytest.raises(RuntimeError, match="fsspec"):
+            File.load("gs://bucket/k")
