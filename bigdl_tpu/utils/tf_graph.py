@@ -37,8 +37,9 @@ __all__ = ["parse_graphdef", "load_graphdef", "TensorflowLoader",
            "save_graphdef"]
 
 _DT_FLOAT, _DT_INT32, _DT_INT64, _DT_BOOL = 1, 3, 9, 10
-_DTYPES = {_DT_FLOAT: np.float32, _DT_INT32: np.int32,
-           _DT_INT64: np.int64, _DT_BOOL: np.bool_}
+_DTYPES = {_DT_FLOAT: np.float32, 2: np.float64, _DT_INT32: np.int32,
+           4: np.uint8, 5: np.int16, 6: np.int8, _DT_INT64: np.int64,
+           _DT_BOOL: np.bool_, 14: np.float16}
 
 
 # ---------------------------------------------------------------------------
@@ -65,6 +66,7 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
     content = b""
     floats: List[float] = []
     ints: List[int] = []
+    strs: List[bytes] = []
     for f, wt, val in pw.fields(buf):
         if f == 1:
             dtype = _DTYPES.get(val, np.float32)
@@ -76,6 +78,13 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
             floats.extend(pw.packed_floats(val, wt))
         elif f in (6, 10):
             ints.extend(pw.packed_varints(val, wt))
+        elif f == 8:  # string_val (DT_STRING tensors: filenames, keys)
+            strs.append(val)
+    if strs:
+        arr = np.empty(len(strs), dtype=object)
+        arr[:] = strs
+        return arr.reshape(shape) if shape and arr.size == int(
+            np.prod(shape)) else arr
     if content:
         arr = np.frombuffer(content, dtype).copy()
     elif floats:
@@ -110,7 +119,7 @@ def _parse_attr(buf: bytes):
         if f == 8:
             return _parse_tensor(val)
         if f == 1:  # list
-            ints, floats, strs = [], [], []
+            ints, floats, strs, shapes = [], [], [], []
             for f2, wt2, v2 in pw.fields(val):
                 if f2 == 2:
                     strs.append(v2)
@@ -118,7 +127,11 @@ def _parse_attr(buf: bytes):
                     ints.extend(pw.packed_varints(v2, wt2))
                 elif f2 == 4:
                     floats.extend(pw.packed_floats(v2, wt2))
-            return ints or floats or strs
+                elif f2 == 6:  # type list (e.g. Tdense) — dtype enums
+                    ints.extend(pw.packed_varints(v2, wt2))
+                elif f2 == 7:  # shape list (e.g. dense_shapes)
+                    shapes.append(_parse_shape(v2))
+            return ints or floats or strs or shapes
     return None
 
 
@@ -158,9 +171,14 @@ class TensorflowLoader:
     """Map parsed NodeDefs onto a ``nn.Graph`` (the op table mirrors the
     reference's ``utils/tf/loaders``)."""
 
-    def __init__(self, graphdef: bytes, inputs: Sequence[str],
+    def __init__(self, graphdef, inputs: Sequence[str],
                  outputs: Sequence[str], train_consts: bool = False):
-        self.nodes = {n["name"]: n for n in parse_graphdef(graphdef)}
+        """``graphdef``: binary GraphDef bytes, or an already-parsed node
+        list (as from ``parse_graphdef`` — used by the Session path after
+        input-pipeline rewriting)."""
+        if isinstance(graphdef, (bytes, bytearray)):
+            graphdef = parse_graphdef(graphdef)
+        self.nodes = {n["name"]: n for n in graphdef}
         self.input_names = list(inputs)
         self.output_names = list(outputs)
         self.train_consts = train_consts

@@ -1,0 +1,309 @@
+"""TF Session equivalent: train an imported TF graph END-TO-END,
+interpreting its queue/reader input pipeline (SURVEY §2.9; reference
+``utils/tf/Session.scala:48,150-263,435-461`` ``BigDLSessionImpl``).
+
+The reference walks a TF 1.x input pipeline — filename queue ->
+TFRecordReader -> ParseExample -> batch queue -> dequeue — and turns it
+into an RDD feeding a DistriOptimizer.  Here the same node patterns are
+interpreted HOST-side into a :class:`~bigdl_tpu.dataset.dataset.DataSet`
+(the queues never execute on device; TPU feeding is the train step's
+sharded batch path), while the compute subgraph downstream of the
+dequeue becomes a trainable ``nn.Graph`` via ``TensorflowLoader`` with
+Const weights promoted to Variables.
+
+Supported pipeline ops (the reference's set, ``Session.scala:150-263``):
+``FIFOQueueV2``/``PaddingFIFOQueueV2``/``RandomShuffleQueueV2`` (+ V1
+names), ``QueueEnqueue(Many)V2``, ``QueueDequeue(Many/UpTo)V2``,
+``ReaderReadV2`` over ``TFRecordReaderV2``, ``ParseExampleV2`` /
+``ParseSingleExample``, with ``Identity``/control-dep hops between.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils.tf_graph import TensorflowLoader, parse_graphdef
+
+__all__ = ["TFTrainingSession"]
+
+_QUEUE_OPS = {"FIFOQueueV2", "PaddingFIFOQueueV2", "RandomShuffleQueueV2",
+              "FIFOQueue", "PaddingFIFOQueue", "RandomShuffleQueue"}
+_DEQUEUE_OPS = {"QueueDequeueManyV2", "QueueDequeueUpToV2", "QueueDequeueV2",
+                "QueueDequeueMany", "QueueDequeueUpTo", "QueueDequeue"}
+_ENQUEUE_OPS = {"QueueEnqueueV2", "QueueEnqueueManyV2", "QueueEnqueue",
+                "QueueEnqueueMany"}
+_PARSE_OPS = {"ParseExampleV2", "ParseExample", "ParseSingleExample"}
+_READER_OPS = {"ReaderReadV2", "ReaderRead"}
+
+from bigdl_tpu.utils.tf_graph import _DTYPES as _TF_DTYPES  # one table
+
+
+def _split_ref(ref: str) -> Tuple[str, int]:
+    ref = ref.lstrip("^")
+    if ":" in ref:
+        name, port = ref.rsplit(":", 1)
+        return name, int(port)
+    return ref, 0
+
+
+class TFTrainingSession:
+    """Interpret a GraphDef's input pipeline and train its compute graph.
+
+    ``train(outputs, criterion, optim_method, ...)`` returns the trained
+    ``nn.Graph``; dequeue components consumed by the compute graph become
+    its inputs (in graph order) and the remaining component is the label
+    fed to the criterion — matching how ``BigDLSessionImpl.train``
+    splits endpoints (``Session.scala:435-461``)."""
+
+    def __init__(self, graphdef):
+        self.nodes: List[Dict] = (parse_graphdef(graphdef)
+                                  if isinstance(graphdef, (bytes, bytearray))
+                                  else list(graphdef))
+        self.by_name = {n["name"]: n for n in self.nodes}
+
+    # -- pipeline interpretation ------------------------------------------
+    def _node(self, ref: str) -> Dict:
+        name, _ = _split_ref(ref)
+        if name not in self.by_name:
+            raise KeyError(f"unknown node {name!r}")
+        return self.by_name[name]
+
+    def _follow_identity(self, ref: str) -> Dict:
+        """Skip Identity/control-dep hops to the producing node."""
+        node = self._node(ref)
+        while node["op"] in ("Identity", "StopGradient"):
+            data_ins = [i for i in node["inputs"] if not i.startswith("^")]
+            node = self._node(data_ins[0])
+        return node
+
+    def _find_enqueue(self, queue_name: str) -> Dict:
+        for n in self.nodes:
+            if n["op"] in _ENQUEUE_OPS and n["inputs"] \
+                    and _split_ref(n["inputs"][0])[0] == queue_name:
+                return n
+        raise ValueError(f"no enqueue op found for queue {queue_name!r}")
+
+    def _filenames(self, queue_ref: str) -> List[str]:
+        """Filename queue -> the Const string list enqueued into it."""
+        qnode = self._follow_identity(queue_ref)
+        if qnode["op"] not in _QUEUE_OPS:
+            raise ValueError(f"reader's queue is {qnode['op']}, not a queue")
+        enq = self._find_enqueue(qnode["name"])
+        names: List[str] = []
+        for ref in enq["inputs"][1:]:
+            if ref.startswith("^"):  # control dep, not a data component
+                continue
+            src = self._follow_identity(ref)
+            if src["op"] != "Const":
+                raise NotImplementedError(
+                    f"filename source {src['op']} unsupported (want Const)")
+            val = src["attrs"].get("value")
+            for f in np.asarray(val).reshape(-1):
+                names.append(f.decode() if isinstance(f, bytes) else str(f))
+        return names
+
+    def _dense_spec(self, pe: Dict) -> Tuple[List[str], List, List[List[int]], int]:
+        """(dense keys, dtypes, shapes, first dense output port)."""
+        a = pe["attrs"]
+        if pe["op"] == "ParseSingleExample":
+            keys = [k.decode() if isinstance(k, bytes) else k
+                    for k in (a.get("dense_keys") or [])]
+            num_sparse = int(a.get("num_sparse") or 0)
+            first_dense = 3 * num_sparse
+        elif pe["op"] == "ParseExampleV2":
+            # inputs: serialized, names, sparse_keys, dense_keys,
+            # ragged_keys, dense_defaults...
+            keys_node = self._follow_identity(pe["inputs"][3])
+            raw = np.asarray(keys_node["attrs"].get("value")).reshape(-1)
+            keys = [k.decode() if isinstance(k, bytes) else str(k)
+                    for k in raw]
+            num_sparse = int(a.get("num_sparse") or 0)
+            # output order: sparse_indices*, sparse_values*,
+            # sparse_shapes*, dense_values*
+            first_dense = 3 * num_sparse
+        else:
+            raise NotImplementedError(
+                "ParseExample (v1, variadic keys) unsupported; re-export "
+                "with ParseExampleV2/ParseSingleExample")
+        dtypes = a.get("Tdense") or []
+        dtypes = [_TF_DTYPES.get(int(d), np.float32) for d in dtypes]
+        shapes = a.get("dense_shapes") or [[] for _ in keys]
+        return keys, dtypes, shapes, first_dense
+
+    def _serialized_source(self, pe: Dict) -> List[str]:
+        """The ParseExample's serialized input -> TFRecord filenames."""
+        reader = self._follow_identity(pe["inputs"][0])
+        if reader["op"] not in _READER_OPS:
+            raise NotImplementedError(
+                f"serialized source {reader['op']} unsupported "
+                f"(want ReaderReadV2)")
+        reader_impl = self._follow_identity(reader["inputs"][0])
+        if reader_impl["op"] not in ("TFRecordReaderV2", "TFRecordReader"):
+            raise NotImplementedError(
+                f"reader {reader_impl['op']} unsupported (want TFRecord)")
+        return self._filenames(reader["inputs"][1])
+
+    def interpret_pipeline(self, dequeue_name: str):
+        """dequeue node -> (filenames, [(key, dtype, shape)] per component).
+
+        Walks: dequeue -> its queue -> the enqueue feeding it -> each
+        enqueued component -> ParseExample dense output -> reader files.
+        """
+        deq = self.by_name[dequeue_name]
+        queue = self._follow_identity(deq["inputs"][0])
+        enq = self._find_enqueue(queue["name"])
+        filenames: Optional[List[str]] = None
+        comps: List[Tuple[str, object, List[int]]] = []
+        for ref in enq["inputs"][1:]:
+            if ref.startswith("^"):  # control dep, not a data component
+                continue
+            name, port = _split_ref(ref)
+            src = self._follow_identity(ref)
+            if src["op"] not in _PARSE_OPS:
+                raise NotImplementedError(
+                    f"enqueued component from {src['op']} unsupported "
+                    f"(want ParseExample*)")
+            keys, dtypes, shapes, first_dense = self._dense_spec(src)
+            di = port - first_dense
+            if not 0 <= di < len(keys):
+                raise NotImplementedError(
+                    f"component port {port} is not a dense output")
+            dtype = dtypes[di] if di < len(dtypes) else np.float32
+            shape = list(shapes[di]) if di < len(shapes) else []
+            comps.append((keys[di], dtype, shape))
+            files = self._serialized_source(src)
+            if filenames is None:
+                filenames = files
+            elif filenames != files:
+                raise NotImplementedError("components read different files")
+        if filenames is None:
+            raise ValueError(f"dequeue {dequeue_name!r} has no components")
+        return filenames, comps
+
+    def _walk_compute(self, output_names: Sequence[str]):
+        """One ancestor walk of ``outputs``: (compute-node keep set,
+        dequeue nodes found).  Dequeues end the walk — the pipeline
+        behind them is interpreted host-side, not compiled."""
+        seen, dequeues = set(), []
+        stack = [_split_ref(o)[0] for o in output_names]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            node = self.by_name.get(name)
+            if node is None:
+                continue
+            if node["op"] in _DEQUEUE_OPS:
+                if name not in dequeues:
+                    dequeues.append(name)
+                continue
+            seen.add(name)
+            stack.extend(_split_ref(i)[0] for i in node["inputs"])
+        return seen, dequeues
+
+    # -- dataset construction ---------------------------------------------
+    @staticmethod
+    def _records(filenames: List[str], comps) -> List[Tuple[np.ndarray, ...]]:
+        from bigdl_tpu.dataset.tfrecord import TFRecordIterator, parse_example
+
+        out = []
+        for path in filenames:
+            for rec in TFRecordIterator(path):
+                feats = parse_example(rec)
+                row = []
+                for key, dtype, shape in comps:
+                    if key not in feats:
+                        raise KeyError(f"record missing feature {key!r}")
+                    v = feats[key]
+                    if isinstance(v, list):  # bytes feature
+                        raise NotImplementedError(
+                            f"bytes feature {key!r} unsupported in training "
+                            f"pipeline")
+                    arr = np.asarray(v).astype(dtype)
+                    row.append(arr.reshape(shape) if shape else
+                               (arr.reshape(()) if arr.size == 1 else arr))
+                out.append(tuple(row))
+        return out
+
+    # -- the public API ----------------------------------------------------
+    def build(self, output_names: Sequence[str], train_consts: bool = True):
+        """Return (model, dataset_records, graph_component_indices,
+        label_component_indices)."""
+        keep, dequeues = self._walk_compute(output_names)
+        if len(dequeues) != 1:
+            raise NotImplementedError(
+                f"expected exactly one dequeue feeding the compute graph, "
+                f"found {dequeues}")
+        deq = dequeues[0]
+        filenames, comps = self.interpret_pipeline(deq)
+        records = self._records(filenames, comps)
+
+        # rewrite "deq:k" refs to synthetic input names "deq__k"
+        def rewrite(ref: str) -> str:
+            name, port = _split_ref(ref)
+            return f"{name}__{port}" if name == deq else ref
+
+        used_ports = set()
+        compute_nodes = []
+        for n in self.nodes:
+            if n["name"] not in keep:
+                continue
+            n2 = dict(n)
+            n2["inputs"] = [rewrite(i) for i in n["inputs"]
+                            if not i.startswith("^")]
+            for i in n["inputs"]:
+                if i.startswith("^"):  # control dep: not a data port
+                    continue
+                nm, port = _split_ref(i)
+                if nm == deq:
+                    used_ports.add(port)
+            compute_nodes.append(n2)
+        graph_ports = sorted(used_ports)
+        label_ports = [p for p in range(len(comps)) if p not in used_ports]
+        loader = TensorflowLoader(
+            compute_nodes, [f"{deq}__{p}" for p in graph_ports],
+            list(output_names), train_consts=train_consts)
+        return loader.load(), records, graph_ports, label_ports
+
+    def _compute_closure(self, output_names, deq):
+        seen = set()
+        stack = [_split_ref(o)[0] for o in output_names]
+        while stack:
+            name = stack.pop()
+            if name in seen or name == deq:
+                continue
+            seen.add(name)
+            node = self.by_name.get(name)
+            if node is None:
+                continue
+            stack.extend(_split_ref(i)[0] for i in node["inputs"])
+        return seen
+
+    def train(self, output_names: Sequence[str], criterion, optim_method,
+              batch_size: int = 32, end_trigger=None, optimizer_cls=None,
+              **optimizer_kwargs):
+        """Assemble the pipeline + compute graph and run the Optimizer —
+        the whole ``BigDLSessionImpl.train`` flow (``Session.scala:435-461``).
+        Returns the trained ``nn.Graph``."""
+        import bigdl_tpu.optim as optim
+        from bigdl_tpu.dataset.sample import Sample
+
+        model, records, graph_ports, label_ports = self.build(output_names)
+        if len(label_ports) > 1:
+            raise NotImplementedError(
+                f"more than one non-graph dequeue component: {label_ports}")
+        samples = []
+        for row in records:
+            feats = [row[p] for p in graph_ports]
+            labels = [row[p] for p in label_ports] or None
+            samples.append(Sample(feats, labels))
+        cls = optimizer_cls or optim.Optimizer
+        o = cls(model=model, dataset=samples, criterion=criterion,
+                batch_size=batch_size,
+                end_trigger=end_trigger or optim.Trigger.max_epoch(1),
+                **optimizer_kwargs)
+        o.set_optim_method(optim_method)
+        self.optimizer = o
+        return o.optimize()

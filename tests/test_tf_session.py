@@ -1,0 +1,97 @@
+"""TF Session training path (VERDICT r1 item 3; reference
+``utils/tf/Session.scala:48,150-263,435-461``): a REAL GraphDef built by
+TensorFlow with a TFRecord queue input pipeline (string_input_producer ->
+TFRecordReader -> parse_single_example -> batch queue) is interpreted
+into a host DataSet, its compute subgraph becomes a trainable Graph, and
+the Optimizer trains it to a loss target.
+
+Fixture generation needs the real TensorFlow package (the reference's
+oracle discipline: its tests shell out to real Lua Torch, gated on
+availability — SURVEY §4); skipped when absent.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import bigdl_tpu.nn as nn  # noqa: E402
+import bigdl_tpu.optim as optim  # noqa: E402
+from bigdl_tpu.utils.tf_session import TFTrainingSession  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def pipeline_graphdef(tmp_path_factory):
+    """(graphdef bytes, tfrecord path): a learnable 3-class problem
+    (label = argmax of the first 3 features) behind a TF queue pipeline."""
+    tmp = tmp_path_factory.mktemp("tfsess")
+    rec_path = str(tmp / "train.tfrecord")
+    rng = np.random.RandomState(0)
+    with tf.io.TFRecordWriter(rec_path) as w:
+        for _ in range(96):
+            x = rng.randn(6).astype(np.float32)
+            y = int(np.argmax(x[:3]))
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "x": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=x)),
+                "y": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[y])),
+            }))
+            w.write(ex.SerializeToString())
+
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+    g = tf1.Graph()
+    with g.as_default():
+        fq = tf1.train.string_input_producer([rec_path], shuffle=False)
+        reader = tf1.TFRecordReader()
+        _, serialized = reader.read(fq)
+        feats = tf1.parse_single_example(serialized, features={
+            "x": tf1.FixedLenFeature([6], tf.float32),
+            "y": tf1.FixedLenFeature([], tf.int64)})
+        bx, _by = tf1.train.batch([feats["x"], feats["y"]], batch_size=8)
+        w1 = tf1.constant(
+            (rng.randn(6, 3) * 0.1).astype(np.float32), name="W")
+        b1 = tf1.constant(np.zeros(3, np.float32), name="b")
+        logits = tf1.nn.bias_add(tf1.matmul(bx, w1, name="mm"), b1,
+                                 name="logits")
+        tf1.nn.log_softmax(logits, name="logprob")
+    return g.as_graph_def().SerializeToString(), rec_path
+
+
+def test_interpret_pipeline(pipeline_graphdef):
+    gd, rec_path = pipeline_graphdef
+    sess = TFTrainingSession(gd)
+    model, records, graph_ports, label_ports = sess.build(["logprob"])
+    assert graph_ports == [0] and label_ports == [1]
+    assert len(records) == 96
+    x0, y0 = records[0]
+    assert x0.shape == (6,) and x0.dtype == np.float32
+    assert y0.shape == () and y0.dtype == np.int64
+    # the imported compute graph is trainable (W, b became Variables)
+    from bigdl_tpu.nn.module import state_dict
+
+    assert len(state_dict(model, kind="param")) == 2
+    # forward works on a batch
+    out = model.evaluate().forward(np.zeros((4, 6), np.float32))
+    assert np.asarray(out).shape == (4, 3)
+
+
+def test_train_imported_graph_reaches_loss_target(pipeline_graphdef):
+    gd, _ = pipeline_graphdef
+    sess = TFTrainingSession(gd)
+    trained = sess.train(
+        ["logprob"], criterion=nn.ClassNLLCriterion(),
+        optim_method=optim.SGD(learning_rate=0.5),
+        batch_size=16, end_trigger=optim.Trigger.max_epoch(6))
+    # evaluate the trained graph on fresh samples of the same rule
+    rng = np.random.RandomState(7)
+    x = rng.randn(64, 6).astype(np.float32)
+    y = np.argmax(x[:, :3], axis=1)
+    logprob = np.asarray(trained.evaluate().forward(x))
+    loss = -logprob[np.arange(64), y].mean()
+    assert loss < 0.75, f"trained loss {loss} did not reach target"
+    acc = (logprob.argmax(1) == y).mean()
+    assert acc > 0.7, f"trained accuracy {acc} too low"
