@@ -202,6 +202,56 @@ def test_cross_map_lrn_matches_torch():
     _cmp(layer.forward(jnp.asarray(x)), ref.numpy(), rtol=1e-4, atol=1e-5)
 
 
+def test_max_pooling_backward_matches_torch():
+    """The fast tie-split VJP (no select-and-scatter) must agree with the
+    torch oracle on continuous inputs (ties have measure zero)."""
+    for kw, kh, dw, dh, pw, ph, ceil in [(3, 3, 2, 2, 1, 1, False),
+                                         (3, 3, 1, 1, 1, 1, False),
+                                         (3, 3, 2, 2, 0, 0, True),
+                                         (2, 2, 2, 2, 0, 0, False)]:
+        layer = nn.SpatialMaxPooling(kw, kh, dw, dh, pw, ph)
+        if ceil:
+            layer.ceil()
+        assert layer.tie_split
+        x_np = np.random.randn(2, 3, 9, 9).astype(np.float32)
+        _grad_cmp(layer, x_np,
+                  lambda t: F.max_pool2d(t, (kh, kw), (dh, dw), (ph, pw),
+                                         ceil_mode=ceil))
+
+
+def test_max_pooling_tie_split_conserves_gradient():
+    """With ties, the fast path splits the cotangent equally among maxima
+    — total gradient mass equals the torch first-argmax convention."""
+    layer = nn.SpatialMaxPooling(2, 2, 2, 2)
+    x = jnp.ones((1, 1, 4, 4), jnp.float32)  # every window fully tied
+    g = layer.backward(x, jnp.ones((1, 1, 2, 2), jnp.float32))
+    assert float(jnp.sum(g)) == pytest.approx(4.0)
+    np.testing.assert_allclose(np.asarray(g), 0.25 * np.ones((1, 1, 4, 4)))
+
+
+def test_max_pooling_torch_ties_path():
+    layer = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1).torch_ties()
+    x_np = np.random.randn(2, 3, 9, 9).astype(np.float32)
+    _grad_cmp(layer, x_np, lambda t: F.max_pool2d(t, 3, 2, 1))
+
+
+def test_cross_map_lrn_backward_and_variants():
+    """The banded-matmul LRN (MXU path): backward vs torch, NHWC layout,
+    and the generic-beta fallback."""
+    x_np = np.random.randn(2, 7, 4, 4).astype(np.float32)
+    _grad_cmp(nn.SpatialCrossMapLRN(5, 0.0001, 0.75, 1.0), x_np,
+              lambda t: torch.nn.LocalResponseNorm(5, 0.0001, 0.75, 1.0)(t))
+    # beta != 0.75 exercises the jnp.power fallback
+    _grad_cmp(nn.SpatialCrossMapLRN(3, 0.001, 0.5, 2.0), x_np,
+              lambda t: torch.nn.LocalResponseNorm(3, 0.001, 0.5, 2.0)(t))
+    # NHWC agrees with NCHW
+    lrn_c = nn.SpatialCrossMapLRN(5, 0.0001, 0.75, 1.0)
+    lrn_l = nn.SpatialCrossMapLRN(5, 0.0001, 0.75, 1.0, format="NHWC")
+    out_c = lrn_c.forward(jnp.asarray(x_np))
+    out_l = lrn_l.forward(jnp.asarray(x_np.transpose(0, 2, 3, 1)))
+    _cmp(jnp.transpose(out_l, (0, 3, 1, 2)), out_c)
+
+
 def test_dropout_keeps_expectation():
     layer = nn.Dropout(0.4)
     x = jnp.ones((1000, 20))

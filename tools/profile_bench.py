@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of one bench config and print the per-op
+time breakdown (parsed from the xplane proto via TF's profiler protos).
+
+Usage: python tools/profile_bench.py [config] [batch] [iters]
+"""
+
+import glob
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def capture(config_name="inception_v1_imagenet", batch=None, iters=8,
+            logdir="/tmp/jaxprof"):
+    import bench
+
+    cfgs = bench._configs()
+    build_model, build_batch, criterion, b = cfgs[config_name]
+    if batch:
+        b = batch
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.parallel.train_step import TrainStep
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(0)
+    model = build_model()
+    step = TrainStep(model, criterion,
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+    x, y = build_batch(b)
+    step.aot_scan(x, y, jax.random.key(0), iters)
+    # warmup
+    step.run_scan(x, y, jax.random.key(1), iters)
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        step.run_scan(x, y, jax.random.key(2), iters)
+        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    return logdir
+
+
+def parse_xplane(logdir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    assert paths, f"no xplane under {logdir}"
+    path = max(paths, key=os.path.getmtime)
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "/device:" not in plane.name:
+            continue
+        print(f"== plane: {plane.name}")
+        ev_meta = plane.event_metadata
+        by_op = defaultdict(float)
+        total = 0.0
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and "Steps" not in line.name \
+                    and "XLA Modules" not in line.name:
+                continue
+            if "XLA Ops" not in line.name:
+                continue
+            for ev in line.events:
+                name = ev_meta[ev.metadata_id].name
+                dur = ev.duration_ps / 1e12
+                by_op[name] += dur
+                total += dur
+        if not by_op:
+            continue
+        # async ops (copy-start/slice-start) and the outer scan `while`
+        # OVERLAP compute — their durations span until -done. Split them out
+        # and report the real compute ops (the while body) separately.
+        def head(n):
+            return n.lstrip("%").split(" ")[0].split(".")[0]
+
+        ASYNC = ("copy-start", "slice-start", "copy-done", "slice-done",
+                 "while", "async-start", "async-done")
+        sync = {n: d for n, d in by_op.items() if head(n) not in ASYNC}
+        stotal = sum(sync.values())
+        print(f"total traced: {total*1e3:.1f} ms; compute (sync) ops: "
+              f"{stotal*1e3:.1f} ms")
+        fam = defaultdict(float)
+        for name, dur in sync.items():
+            fam[head(name)] += dur
+        for name, dur in sorted(fam.items(), key=lambda kv: -kv[1])[:30]:
+            print(f"  {name:60s} {dur*1e3:9.3f} ms  {100*dur/stotal:5.1f}%")
+        print("-- top individual sync ops:")
+        for name, dur in sorted(sync.items(), key=lambda kv: -kv[1])[:30]:
+            print(f"  {name[:110]:110s} {dur*1e3:9.3f} ms  {100*dur/stotal:5.1f}%")
+
+
+if __name__ == "__main__":
+    cfg = sys.argv[1] if len(sys.argv) > 1 else "inception_v1_imagenet"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    logdir = capture(cfg, batch, iters)
+    parse_xplane(logdir)
