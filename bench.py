@@ -87,6 +87,10 @@ def peak_flops_per_sec():
     for name, peak in PEAK_TFLOPS.items():
         if kind.lower().startswith(name.lower()):
             return peak * 1e12
+    if kind != "cpu":  # cpu has no meaningful MFU denominator
+        print(f"# WARNING: unknown device kind {kind!r} — not in the "
+              "PEAK_TFLOPS table, so no 'mfu' field will be reported "
+              "(set BENCH_PEAK_TFLOPS to override)", file=sys.stderr)
     return None
 
 
@@ -108,7 +112,9 @@ def run_config(name, build_model, build_batch, criterion, batch, iters):
     # same way.  The AOT compile also yields XLA's cost analysis (scan
     # body counted once).
     flops = None
+    t_c0 = time.perf_counter()
     cost = step.aot_scan(x, y, jax.random.key(0), iters)
+    compile_s = time.perf_counter() - t_c0
     if cost and cost.get("flops"):
         flops = float(cost["flops"])
 
@@ -124,12 +130,22 @@ def run_config(name, build_model, build_batch, criterion, batch, iters):
     drain()  # the warmup scan's LAST param update must not leak into t0
 
     t0 = time.perf_counter()
-    step.run_scan(x, y, jax.random.key(2), iters)
+    xs, ys = step._shard_batch(x, y)
+    t_h2d = time.perf_counter()
+    step.run_scan_sharded(xs, ys, jax.random.key(2))
+    t_dispatch = time.perf_counter()
     drain()
     wall = time.perf_counter() - t0
 
     rate = batch * iters / wall
-    out = {"images_per_sec": round(rate, 2), "batch": batch}
+    out = {"images_per_sec": round(rate, 2), "batch": batch,
+           # host-loop stage breakdown (optim/Metrics.scala:31-130
+           # re-scope; see docs/straggler.md): compile / h2d / dispatch /
+           # device-sync seconds for the timed window
+           "stages_s": {"compile": round(compile_s, 3),
+                        "h2d": round(t_h2d - t0, 4),
+                        "dispatch": round(t_dispatch - t_h2d, 4),
+                        "device": round(wall - (t_dispatch - t0), 4)}}
     if flops:
         achieved = flops * iters / wall
         out["step_gflops"] = round(flops / 1e9, 2)

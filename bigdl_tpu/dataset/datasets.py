@@ -20,6 +20,7 @@ import numpy as np
 from bigdl_tpu.dataset.image import LabeledImage
 
 __all__ = ["load_mnist", "load_cifar10", "load_news20", "image_folder",
+           "load_movielens", "movielens_id_pairs", "movielens_id_ratings",
            "TRAIN_MEAN", "TRAIN_STD"]
 
 # MNIST normalization constants (pyspark/bigdl/dataset/mnist.py)
@@ -154,3 +155,36 @@ def image_folder(path: str) -> List[LabeledImage]:
                              .convert("RGB"))
             out.append(LabeledImage(img, float(label)))
     return out
+
+
+def load_movielens(data_dir: Optional[str] = None, synthetic_size: int = 2000
+                   ) -> np.ndarray:
+    """MovieLens ratings as an int array of (user, item, rating, timestamp)
+    rows (``pyspark/bigdl/dataset/movielens.py read_data_sets``: parses
+    ``ml-1m/ratings.dat``'s ``::``-separated lines).  Zero-egress here, so
+    when the file is absent a seeded synthetic rating matrix with the same
+    schema is generated instead of downloading."""
+    if data_dir:
+        for rel in ("ml-1m/ratings.dat", "ratings.dat"):
+            path = os.path.join(data_dir, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    rows = [line.strip().split("::") for line in f
+                            if line.strip()]
+                return np.asarray(rows).astype(int)
+    rng = np.random.default_rng(5)
+    users = rng.integers(1, 201, synthetic_size)
+    items = rng.integers(1, 501, synthetic_size)
+    ratings = rng.integers(1, 6, synthetic_size)
+    ts = rng.integers(9e8, 1e9, synthetic_size)
+    return np.stack([users, items, ratings, ts], axis=1).astype(int)
+
+
+def movielens_id_pairs(data_dir: Optional[str] = None) -> np.ndarray:
+    """(user, item) columns (``movielens.py get_id_pairs``)."""
+    return load_movielens(data_dir)[:, 0:2]
+
+
+def movielens_id_ratings(data_dir: Optional[str] = None) -> np.ndarray:
+    """(user, item, rating) columns (``movielens.py get_id_ratings``)."""
+    return load_movielens(data_dir)[:, 0:3]

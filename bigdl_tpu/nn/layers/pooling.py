@@ -143,9 +143,19 @@ class _PoolBase(Module):
             declared[ax] = (lo, dh)
         return tuple(dims), tuple(strides), pads, declared
 
+    #: largest window (taps per element) the unrolled tie-split backward
+    #: may handle — beyond this (e.g. global pooling over a 56x56 map)
+    #: the per-tap unroll would blow up compile time, and XLA's
+    #: select-and-scatter is used instead
+    _TIE_SPLIT_MAX_TAPS = 64
+
     def _max(self, x):
         dims, strides, pads, _ = self._window(x)
-        if self.tie_split and jnp.issubdtype(x.dtype, jnp.floating):
+        taps = 1
+        for d in dims:
+            taps *= d
+        if self.tie_split and taps <= self._TIE_SPLIT_MAX_TAPS \
+                and jnp.issubdtype(x.dtype, jnp.floating):
             return _maxpool_tie_split(x, dims, strides, tuple(pads))
         return lax.reduce_window(x, _max_init(x.dtype), lax.max, dims, strides, pads)
 

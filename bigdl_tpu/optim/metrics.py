@@ -1,13 +1,23 @@
 """Per-stage training metrics (``optim/Metrics.scala:31-130``).
 
-The reference aggregates timings via Spark accumulators across executors;
-here a host-side accumulator keyed by stage name (the SPMD step is one
-device program, so per-stage wall times come from the host loop and,
-optionally, jax profiling)."""
+The reference aggregates six per-stage timings via Spark accumulators
+across executors (computing / get-weights / aggregate-gradient /
+put-gradient / compute-weight / send-weights, set at
+``DistriOptimizer.scala:158-166``).  Under SPMD the gradient exchange
+stages are fused into one XLA program, so the stages worth separating are
+host-observable instead: data wait, host-to-device transfer, compile,
+step dispatch, device sync, validation, and checkpoint — all recorded by
+the Optimizer loop into this accumulator and printed by ``summary()``.
+
+Deeper (op-level) timing comes from the profiler hook: set
+``BIGDL_PROFILE=<dir>`` to capture a ``jax.profiler`` trace of the first
+few training iterations (``BIGDL_PROFILE_ITERS``, default 5)."""
 
 from __future__ import annotations
 
 import threading
+import time
+from contextlib import contextmanager
 from typing import Dict, List
 
 __all__ = ["Metrics"]
@@ -27,20 +37,45 @@ class Metrics:
             self._scalars.setdefault(name, []).append(float(value))
 
     def get(self, name: str) -> float:
+        """Mean of the recorded values (0.0 when empty)."""
         with self._lock:
             vals = self._scalars.get(name, [])
             return sum(vals) / len(vals) if vals else 0.0
+
+    def total(self, name: str) -> float:
+        with self._lock:
+            return sum(self._scalars.get(name, []))
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return len(self._scalars.get(name, []))
+
+    def stages(self) -> List[str]:
+        with self._lock:
+            return sorted(self._scalars)
+
+    @contextmanager
+    def timer(self, name: str):
+        """Accumulate the wall time of the with-block under ``name``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
 
     def reset(self):
         with self._lock:
             self._scalars.clear()
 
     def summary(self, unit_scale: float = 1.0) -> str:
-        """Pretty printer mirroring ``Metrics.summary``."""
+        """Pretty printer mirroring ``Metrics.summary``: per-stage mean,
+        total, and sample count."""
         with self._lock:
             lines = ["========== Metrics Summary =========="]
             for name, vals in sorted(self._scalars.items()):
                 mean = sum(vals) / len(vals) if vals else 0.0
-                lines.append(f"{name} : {mean * unit_scale:.6f} s")
+                lines.append(
+                    f"{name} : mean {mean * unit_scale:.6f} s "
+                    f"(total {sum(vals) * unit_scale:.4f} s, n={len(vals)})")
             lines.append("=====================================")
             return "\n".join(lines)

@@ -37,7 +37,15 @@ from bigdl_tpu.parallel.train_step import EvalStep, TrainStep
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.rng import RNG
 
-__all__ = ["Optimizer", "LocalOptimizer", "DistriOptimizer"]
+__all__ = ["Optimizer", "LocalOptimizer", "DistriOptimizer",
+           "StragglerTimeout"]
+
+
+class StragglerTimeout(RuntimeError):
+    """A training iteration exceeded the host-level straggler budget
+    (see docs/straggler.md).  Raised into the retry loop, which restores
+    the latest checkpoint — the SPMD analogue of the reference's
+    drop-gradients-and-continue (``DistriOptimizer.scala:415-420``)."""
 
 log = logging.getLogger("bigdl_tpu.optim")
 if not log.handlers:
@@ -86,6 +94,9 @@ class Optimizer:
         self.end_when: Trigger = end_trigger or Trigger.max_iteration(2**62)
         self.state: Dict = {"epoch": 1, "neval": 0}
         self.metrics = Metrics()
+        from collections import deque
+
+        self._iteration_times = deque(maxlen=20)  # straggler auto budget
         # validation
         self._val_trigger = None
         self._val_dataset = None
@@ -320,66 +331,166 @@ class Optimizer:
         key0 = jax.random.key(RNG.randint(0, 2**31 - 1))
         epoch_start = time.perf_counter()
 
+        # profiler hook: BIGDL_PROFILE=<dir> traces the first
+        # BIGDL_PROFILE_ITERS iterations (jax.profiler, op-level timings)
+        profile_dir = os.environ.get("BIGDL_PROFILE")
+        profile_iters = int(os.environ.get("BIGDL_PROFILE_ITERS", "5"))
+        profiling = False
+        first_iteration = True
+
         log.info(f"[Optimizer] start training to {mesh} "
                  f"(sync={self.parameter_sync}, compression={self.gradient_compression})")
-        while not self.end_when(self.state):
-            t_start = time.perf_counter()
-            batch: MiniBatch = next(data_iter)
-            t_data = time.perf_counter()
-            key = jax.random.fold_in(key0, self.state["neval"])
-            loss = step.run(batch.get_input(), batch.get_target(), key)
-            loss = float(loss)
-            t_end = time.perf_counter()
-            n = batch.size() * record_scale  # global records this iteration
-            self.state["neval"] += 1
-            self.state["loss"] = loss
-            records_this_epoch += n
-            self.state["records"] = records_this_epoch
-            self.metrics.add("data time", t_data - t_start)
-            self.metrics.add("computing time", t_end - t_data)
-            throughput = n / max(t_end - t_start, 1e-9)
-            log.info(
-                f"[Epoch {self.state['epoch']} {records_this_epoch}/{dataset_size}]"
-                f"[Iteration {self.state['neval']}] Trained {n} records in "
-                f"{t_end - t_start:.4f} seconds. Throughput is {throughput:.1f} "
-                f"records/second. Loss is {loss:.5f}.")
-            self.state["_epoch_boundary"] = False
-            if records_this_epoch >= dataset_size:
-                self.state["epoch"] += 1
-                # expose the epoch to compiled schedules
-                step.opt_state = dict(step.opt_state)
-                step.opt_state["epoch"] = jax.numpy.asarray(self.state["epoch"], jax.numpy.int32)
-                records_this_epoch = 0
-                self.state["records"] = 0
-                self.state["_epoch_boundary"] = True
-                log.info(f"[Epoch {self.state['epoch'] - 1}] finished in "
-                         f"{time.perf_counter() - epoch_start:.2f}s")
-                epoch_start = time.perf_counter()
-            if self._train_summary is not None:
-                ts = self._train_summary
-                # default: scalars on, Parameters histograms opt-in
-                # (TrainSummary.scala:64-88)
-                gate = getattr(ts, "should_write",
-                               lambda tag, st: tag != "Parameters")
-                if gate("Loss", self.state):
-                    ts.add_scalar("Loss", loss, self.state["neval"])
-                if gate("Throughput", self.state):
-                    ts.add_scalar("Throughput", throughput, self.state["neval"])
-                if gate("LearningRate", self.state):
-                    lr = self.optim_method.get_learning_rate()
-                    ts.add_scalar("LearningRate", lr, self.state["neval"])
-                if gate("Parameters", self.state) and hasattr(ts, "add_histogram"):
-                    for pname, arr in step.params.items():
-                        ts.add_histogram(pname, np.asarray(arr),
-                                         self.state["neval"])
-            if self._val_trigger is not None and self._val_trigger(self.state):
-                step.sync_to_model()
-                self._validate(eval_step)
-            if self._ckpt_trigger is not None and self._ckpt_trigger(self.state):
-                self._save_checkpoint(step)
+        try:
+            while not self.end_when(self.state):
+                if profile_dir and not profiling and profile_iters > 0:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                t_start = time.perf_counter()
+                batch: MiniBatch = next(data_iter)
+                t_data = time.perf_counter()
+                key = jax.random.fold_in(key0, self.state["neval"])
+
+                def one_iteration():
+                    th0 = time.perf_counter()
+                    xs, ys = step._shard_batch(batch.get_input(),
+                                               batch.get_target())
+                    t0 = time.perf_counter()
+                    out = step.run_sharded(xs, ys, key)
+                    t1 = time.perf_counter()
+                    out = float(out)  # device sync: the step actually runs
+                    t2 = time.perf_counter()
+                    # timings are recorded by the CALLER so an abandoned
+                    # straggler thread can't pollute Metrics
+                    return out, (t0 - th0, t1 - t0, t2 - t0)
+
+                # the first iteration includes XLA compilation — never
+                # under the straggler budget (docs/straggler.md)
+                if first_iteration:
+                    loss, stage_times = one_iteration()
+                else:
+                    loss, stage_times = \
+                        self._run_with_straggler_guard(one_iteration)
+                h2d_s, dispatch_s, sync_s = stage_times
+                self.metrics.add("host to device time", h2d_s)
+                self.metrics.add("dispatch time", dispatch_s)
+                self.metrics.add("compile + first iteration time" if
+                                 first_iteration else "computing time",
+                                 sync_s)
+                first_iteration = False
+                t_end = time.perf_counter()
+                if profiling:
+                    profile_iters -= 1
+                    if profile_iters <= 0:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        log.info(
+                            f"[Optimizer] profiler trace in {profile_dir}")
+                n = batch.size() * record_scale  # global records this iteration
+                self.state["neval"] += 1
+                self.state["loss"] = loss
+                records_this_epoch += n
+                self.state["records"] = records_this_epoch
+                self.metrics.add("data time", t_data - t_start)
+                self._iteration_times.append(t_end - t_data)
+                throughput = n / max(t_end - t_start, 1e-9)
+                log.info(
+                    f"[Epoch {self.state['epoch']} {records_this_epoch}/{dataset_size}]"
+                    f"[Iteration {self.state['neval']}] Trained {n} records in "
+                    f"{t_end - t_start:.4f} seconds. Throughput is {throughput:.1f} "
+                    f"records/second. Loss is {loss:.5f}.")
+                self.state["_epoch_boundary"] = False
+                if records_this_epoch >= dataset_size:
+                    self.state["epoch"] += 1
+                    # expose the epoch to compiled schedules
+                    step.opt_state = dict(step.opt_state)
+                    step.opt_state["epoch"] = jax.numpy.asarray(self.state["epoch"], jax.numpy.int32)
+                    records_this_epoch = 0
+                    self.state["records"] = 0
+                    self.state["_epoch_boundary"] = True
+                    log.info(f"[Epoch {self.state['epoch'] - 1}] finished in "
+                             f"{time.perf_counter() - epoch_start:.2f}s")
+                    epoch_start = time.perf_counter()
+                if self._train_summary is not None:
+                    ts = self._train_summary
+                    # default: scalars on, Parameters histograms opt-in
+                    # (TrainSummary.scala:64-88)
+                    gate = getattr(ts, "should_write",
+                                   lambda tag, st: tag != "Parameters")
+                    if gate("Loss", self.state):
+                        ts.add_scalar("Loss", loss, self.state["neval"])
+                    if gate("Throughput", self.state):
+                        ts.add_scalar("Throughput", throughput, self.state["neval"])
+                    if gate("LearningRate", self.state):
+                        lr = self.optim_method.get_learning_rate()
+                        ts.add_scalar("LearningRate", lr, self.state["neval"])
+                    if gate("Parameters", self.state) and hasattr(ts, "add_histogram"):
+                        for pname, arr in step.params.items():
+                            ts.add_histogram(pname, np.asarray(arr),
+                                             self.state["neval"])
+                if self._val_trigger is not None and self._val_trigger(self.state):
+                    with self.metrics.timer("validation time"):
+                        step.sync_to_model()
+                        self._validate(eval_step)
+                if self._ckpt_trigger is not None and self._ckpt_trigger(self.state):
+                    with self.metrics.timer("checkpoint time"):
+                        self._save_checkpoint(step)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+                log.info(f"[Optimizer] profiler trace in {profile_dir}")
         step.sync_to_model()
         log.info(self.metrics.summary())
         return self.model
+
+    # -- straggler guard (docs/straggler.md) --------------------------------
+    def _straggler_timeout(self) -> Optional[float]:
+        """Current per-iteration budget in seconds, or None when disabled.
+        ``BIGDL_ITERATION_TIMEOUT``: unset/"0" = off, a float = fixed
+        budget, "auto" = 10x the median of recent iterations (min 60 s,
+        armed after 5 samples) — the host-level analogue of the
+        reference's kth-largest adaptive threshold
+        (``DistriOptimizer.scala:339-367``, ``Util.kthLargest``)."""
+        spec = os.environ.get("BIGDL_ITERATION_TIMEOUT", "").strip()
+        if not spec or spec == "0":
+            return None
+        if spec == "auto":
+            if len(self._iteration_times) < 5:
+                return None
+            med = sorted(self._iteration_times)[len(self._iteration_times) // 2]
+            return max(60.0, 10.0 * med)
+        return float(spec)
+
+    def _run_with_straggler_guard(self, fn):
+        timeout = self._straggler_timeout()
+        if timeout is None:
+            return fn()
+        import queue
+        import threading
+
+        results: "queue.Queue" = queue.Queue(maxsize=1)
+
+        def runner():
+            try:
+                results.put(("ok", fn()))
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                results.put(("err", e))
+
+        # daemon: an abandoned thread blocked on a wedged device call must
+        # not stall interpreter exit (concurrent.futures workers would)
+        threading.Thread(target=runner, daemon=True,
+                         name="bigdl-iteration").start()
+        try:
+            kind, value = results.get(timeout=timeout)
+        except queue.Empty:
+            # the dispatch thread stays blocked on the device; recovery
+            # re-initializes from the last checkpoint (the only safe move
+            # on a synchronous SPMD step — see docs/straggler.md)
+            raise StragglerTimeout(
+                f"iteration exceeded the straggler budget of {timeout:.1f}s "
+                f"(BIGDL_ITERATION_TIMEOUT)") from None
+        if kind == "err":
+            raise value
+        return value
 
 
 class LocalOptimizer(Optimizer):

@@ -247,9 +247,15 @@ class TrainStep:
         Single-host callers pass the GLOBAL batch; multi-host callers pass
         this process's LOCAL shard of it (per-process data sharding, the
         reference's per-node partition feeding)."""
+        x, y = self._shard_batch(x, y)
+        return self.run_sharded(x, y, key)
+
+    def run_sharded(self, x, y, key):
+        """One iteration over batch arrays already placed on the mesh
+        (``_shard_batch``) — lets the host loop time the h2d transfer and
+        the dispatch as separate Metrics stages."""
         if self._compiled is None:
             self._compiled = self._build()
-        x, y = self._shard_batch(x, y)
         self.params, self.opt_state, self.buffers, loss = self._compiled(
             self.params, self.opt_state, self.buffers, x, y, key)
         return loss
@@ -292,6 +298,15 @@ class TrainStep:
                 or self._scan_cache[0] != cache_key:
             self._scan_cache = (cache_key, self._build_scan(n, stacked))
         x, y = self._shard_batch(x, y, stacked)
+        return self.run_scan_sharded(x, y, key)
+
+    def run_scan_sharded(self, x, y, key):
+        """The dispatch half of :meth:`run_scan` over batch arrays already
+        placed on the mesh — lets benchmarks time h2d and dispatch
+        separately (the scan must have been built by ``run_scan`` or
+        ``aot_scan`` first)."""
+        if getattr(self, "_scan_cache", None) is None:
+            raise RuntimeError("no compiled scan: call run_scan/aot_scan")
         self.params, self.opt_state, self.buffers, losses = \
             self._scan_cache[1](self.params, self.opt_state, self.buffers,
                                 x, y, key)

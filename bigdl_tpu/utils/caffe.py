@@ -1,6 +1,8 @@
 """Caffe model import: prototxt structure + caffemodel weights
 (``utils/caffe/CaffeLoader.scala:56``, ``Converter.scala``,
 ``LayerConverter.scala``/``V1LayerConverter.scala`` — SURVEY §2.9).
+The save direction lives in ``bigdl_tpu.utils.caffe_persister``
+(``CaffePersister.scala:47``); the two round-trip.
 
 Two pieces, neither needing a protobuf runtime:
 
@@ -182,9 +184,10 @@ def load_caffemodel_blobs(path: str) -> Dict[str, List[np.ndarray]]:
 
 def _pair(param, key, default=0):
     """Caffe's h/w convention: ``key_h``/``key_w`` override scalar/repeated
-    ``key``."""
-    h = param.get(f"{key}_h")
-    w = param.get(f"{key}_w")
+    ``key`` (the pair fields for ``kernel_size`` are ``kernel_h/w``)."""
+    base = "kernel" if key == "kernel_size" else key
+    h = param.get(f"{base}_h")
+    w = param.get(f"{base}_w")
     if h is not None or w is not None:
         return int(h or default), int(w or default)
     v = _as_list(param.get(key, default))
@@ -374,15 +377,18 @@ class CaffeLoader:
             ph, pw_ = _pair(p, "pad", 0)
             pool = p.get("pool", "MAX")
             glob = bool(p.get("global_pooling", False))
+            # caffe defaults to CEIL output rounding; FLOOR is explicit
+            ceil = p.get("round_mode", "CEIL") in ("CEIL", 0)
             if pool in ("MAX", 0):
                 m = nn.SpatialMaxPooling(kw or 1, kh or 1, dw, dh, pw_, ph,
                                          global_pooling=glob)
-                m.ceil()  # caffe pooling uses ceil output sizes
+                if ceil:
+                    m.ceil()
             else:
                 m = nn.SpatialAveragePooling(kw or 1, kh or 1, dw, dh,
                                              pw_, ph,
                                              global_pooling=glob,
-                                             ceil_mode=True)
+                                             ceil_mode=ceil)
             return m, in_channels
         if t in ("ReLU", "18"):
             return nn.ReLU(), in_channels

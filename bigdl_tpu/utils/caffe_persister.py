@@ -1,0 +1,379 @@
+"""Caffe model export — the save side of the Caffe interop
+(``utils/caffe/CaffePersister.scala:47`` + the save-direction emitters in
+``Converter.scala``/``LayerConverter.scala``, SURVEY §2.9).
+
+Emits the two Caffe artifacts:
+
+- **prototxt** (NetParameter text format): the layer DAG with typed
+  parameter blocks, written by a small inverse of
+  ``bigdl_tpu.utils.caffe.parse_prototxt``.
+- **caffemodel** (binary NetParameter via ``protowire``): per-layer
+  name/type/bottom/top plus weight BlobProtos (V2 ``layer`` field 100,
+  BlobShape + packed float data).  Structure parameters live in the
+  prototxt — like the reference, loading pairs the two files.
+
+Round-trips with ``bigdl_tpu.utils.caffe.CaffeLoader``: the emitter table
+below is the inverse of the loader's converter table, so
+save → load → forward is identity for every supported layer type.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from bigdl_tpu.utils import protowire as pw
+
+__all__ = ["CaffePersister", "save_caffe"]
+
+
+class _Enum(str):
+    """Marker: render without quotes in prototxt (enum identifier)."""
+
+
+def _fmt_scalar(v) -> str:
+    if isinstance(v, _Enum):
+        return str(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return f'"{v}"'
+    if isinstance(v, float):
+        # repr keeps round-trip precision; prototxt accepts it
+        return repr(v)
+    return str(v)
+
+
+def to_prototxt(msg: Dict, indent: int = 0) -> str:
+    """Inverse of ``caffe.parse_prototxt``: nested dicts to text format."""
+    pad = "  " * indent
+    out = []
+    for key, value in msg.items():
+        for v in (value if isinstance(value, list) else [value]):
+            if isinstance(v, dict):
+                out.append(f"{pad}{key} {{")
+                out.append(to_prototxt(v, indent + 1))
+                out.append(f"{pad}}}")
+            else:
+                out.append(f"{pad}{key}: {_fmt_scalar(v)}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# binary NetParameter
+# ---------------------------------------------------------------------------
+
+def _blob_proto(arr: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(np.asarray(arr), dtype=np.float32)
+    dims = b"".join(pw.write_varint(int(d)) for d in a.shape)
+    shape = pw.emit_bytes(1, dims)                      # BlobShape.dim packed
+    data = pw.emit_bytes(5, struct.pack(f"<{a.size}f", *a.ravel().tolist()))
+    return pw.emit_bytes(7, shape) + data               # BlobProto.shape
+
+
+def _layer_param(name: str, type_: str, bottoms: Sequence[str],
+                 tops: Sequence[str], blobs: Sequence[np.ndarray]) -> bytes:
+    payload = pw.emit_bytes(1, name.encode())
+    payload += pw.emit_bytes(2, type_.encode())
+    for b in bottoms:
+        payload += pw.emit_bytes(3, b.encode())
+    for t in tops:
+        payload += pw.emit_bytes(4, t.encode())
+    for blob in blobs:
+        payload += pw.emit_bytes(7, _blob_proto(blob))
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# module -> layer emission
+# ---------------------------------------------------------------------------
+
+class CaffePersister:
+    """Walk a module tree (Sequential chain, Concat branches, or a Graph
+    DAG as built by CaffeLoader) and persist it as prototxt + caffemodel.
+
+    ``input_shapes``: {blob_name: (N, C, H, W)} (or one tuple for the
+    single-input case) — emitted as Caffe ``Input`` layers so the loader
+    can re-infer channel counts without weight blobs.
+    ``customized_emitters``: {ModuleClass: fn(module, name, bottoms,
+    persister) -> top_name} to extend the table (the save-side mirror of
+    the loader's customizedConverters hook)."""
+
+    def __init__(self, model, input_shapes=None, net_name: str = "bigdl_tpu",
+                 customized_emitters: Optional[Dict] = None):
+        self.model = model
+        self.net_name = net_name
+        self.layers: List[Dict] = []   # prototxt layer dicts
+        self.blobs: Dict[str, List[np.ndarray]] = {}
+        self.customized = dict(customized_emitters or {})
+        self._counter = 0
+        if input_shapes is None:
+            self.input_shapes = {}
+        elif isinstance(input_shapes, dict):
+            self.input_shapes = dict(input_shapes)
+        else:
+            self.input_shapes = {"data": tuple(input_shapes)}
+
+    # -- plumbing ----------------------------------------------------------
+    def _fresh(self, hint: str) -> str:
+        self._counter += 1
+        return f"{hint}{self._counter}"
+
+    def _name_of(self, module, hint: str) -> str:
+        name = module.get_name() if hasattr(module, "get_name") else None
+        cls = type(module).__name__
+        if name and not name.startswith(cls + "@"):  # auto names regenerate
+            return name
+        return self._fresh(hint)
+
+    def _add(self, name: str, type_: str, bottoms: Sequence[str],
+             top: str, params: Optional[Dict] = None,
+             blobs: Optional[List[np.ndarray]] = None) -> str:
+        layer = {"name": name, "type": type_,
+                 "bottom": list(bottoms), "top": top}
+        if params:
+            layer.update(params)
+        self.layers.append(layer)
+        if blobs:
+            self.blobs[name] = [np.asarray(b, np.float32) for b in blobs]
+        return top
+
+    # -- emitters ----------------------------------------------------------
+    def _emit(self, module, bottoms: List[str]) -> str:
+        """Emit ``module`` fed by blob names ``bottoms``; return its top."""
+        import bigdl_tpu.nn as nn
+
+        m = module
+        for cls, fn in self.customized.items():
+            if isinstance(m, cls):
+                return fn(m, self._name_of(m, "custom"), bottoms, self)
+
+        # ---- containers -------------------------------------------------
+        if isinstance(m, nn.Graph):
+            return self._emit_graph(m, bottoms)
+        if isinstance(m, nn.Sequential):
+            fused = self._fused_sequential(m, bottoms)
+            if fused is not None:
+                return fused
+            top = bottoms
+            for child in m.layers:
+                top = [self._emit(child, top)]
+            return top[0]
+        if isinstance(m, nn.Concat):
+            name = self._name_of(m, "concat")
+            tops = [self._emit(child, bottoms) for child in m.layers]
+            return self._add(name, "Concat", tops, name,
+                             {"concat_param": {"axis": int(m.dim)}})
+
+        # ---- weighted layers --------------------------------------------
+        if isinstance(m, nn.SpatialFullConvolution):
+            name = self._name_of(m, "deconv")
+            p = {"num_output": int(m.n_output_plane),
+                 "kernel_h": int(m.kh), "kernel_w": int(m.kw),
+                 "stride_h": int(m.dh), "stride_w": int(m.dw),
+                 "pad_h": int(m.pad_h), "pad_w": int(m.pad_w)}
+            if m.n_group != 1:
+                p["group"] = int(m.n_group)
+            if not m.with_bias:
+                p["bias_term"] = False
+            blobs = [np.asarray(m.weight)]
+            if m.with_bias:
+                blobs.append(np.asarray(m.bias))
+            return self._add(name, "Deconvolution", bottoms, name,
+                             {"convolution_param": p}, blobs)
+        if isinstance(m, nn.SpatialConvolution):
+            name = self._name_of(m, "conv")
+            p = {"num_output": int(m.n_output_plane),
+                 "kernel_h": int(m.kernel_h), "kernel_w": int(m.kernel_w),
+                 "stride_h": int(m.stride_h), "stride_w": int(m.stride_w),
+                 "pad_h": int(m.pad_h), "pad_w": int(m.pad_w)}
+            if m.n_group != 1:
+                p["group"] = int(m.n_group)
+            if not m.with_bias:
+                p["bias_term"] = False
+            blobs = [np.asarray(m.weight)]
+            if m.with_bias:
+                blobs.append(np.asarray(m.bias))
+            return self._add(name, "Convolution", bottoms, name,
+                             {"convolution_param": p}, blobs)
+        if isinstance(m, nn.Linear):
+            name = self._name_of(m, "fc")
+            p = {"num_output": int(m.weight.shape[0])}
+            blobs = [np.asarray(m.weight)]
+            if getattr(m, "with_bias", True) and "bias" in m.__dict__["_params"]:
+                blobs.append(np.asarray(m.bias))
+            else:
+                p["bias_term"] = False
+            return self._add(name, "InnerProduct", bottoms, name,
+                             {"inner_product_param": p}, blobs)
+        if isinstance(m, nn.SpatialBatchNormalization):
+            name = self._name_of(m, "bn")
+            top = self._add(
+                name, "BatchNorm", bottoms, name,
+                {"batch_norm_param": {"use_global_stats": True}},
+                [np.asarray(m.running_mean), np.asarray(m.running_var),
+                 np.ones((1,), np.float32)])
+            if m.affine:
+                sname = self._fresh("scale")
+                top = self._add(sname, "Scale", [top], sname,
+                                {"scale_param": {"bias_term": True}},
+                                [np.asarray(m.weight), np.asarray(m.bias)])
+            return top
+        if isinstance(m, nn.CMul):
+            name = self._name_of(m, "scale")
+            return self._add(name, "Scale", bottoms, name,
+                             {"scale_param": {}}, [np.asarray(m.weight)])
+
+        # ---- pooling ----------------------------------------------------
+        if isinstance(m, nn.SpatialAveragePooling) or \
+                isinstance(m, nn.SpatialMaxPooling):
+            is_avg = isinstance(m, nn.SpatialAveragePooling)
+            name = self._name_of(m, "pool")
+            p: Dict[str, object] = {"pool": _Enum("AVE" if is_avg else "MAX")}
+            if m.global_pooling:
+                p["global_pooling"] = True
+            else:
+                p.update({"kernel_h": int(m.kh), "kernel_w": int(m.kw),
+                          "stride_h": int(m.dh), "stride_w": int(m.dw),
+                          "pad_h": int(m.pad_h), "pad_w": int(m.pad_w)})
+            if not m.ceil_mode:
+                p["round_mode"] = _Enum("FLOOR")
+            return self._add(name, "Pooling", bottoms, name,
+                             {"pooling_param": p})
+
+        # ---- parameter-free layers --------------------------------------
+        simple = {nn.ReLU: "ReLU", nn.Tanh: "TanH", nn.Sigmoid: "Sigmoid",
+                  nn.SoftMax: "Softmax", nn.Abs: "AbsVal", nn.Exp: "Exp",
+                  nn.Log: "Log"}
+        for cls, caffe_type in simple.items():
+            if type(m) is cls:
+                name = self._name_of(m, caffe_type.lower())
+                return self._add(name, caffe_type, bottoms, name)
+        if isinstance(m, nn.SpatialCrossMapLRN):
+            name = self._name_of(m, "lrn")
+            return self._add(name, "LRN", bottoms, name, {"lrn_param": {
+                "local_size": int(m.size), "alpha": float(m.alpha),
+                "beta": float(m.beta), "k": float(m.k)}})
+        if isinstance(m, nn.Dropout):
+            name = self._name_of(m, "drop")
+            return self._add(name, "Dropout", bottoms, name, {
+                "dropout_param": {"dropout_ratio": float(m.p)}})
+        if isinstance(m, nn.Power):
+            name = self._name_of(m, "power")
+            return self._add(name, "Power", bottoms, name, {"power_param": {
+                "power": float(m.power), "scale": float(m.scale),
+                "shift": float(m.shift)}})
+        if isinstance(m, nn.InferReshape):
+            name = self._name_of(m, "reshape")
+            if tuple(m.size) == (0, -1):
+                return self._add(name, "Flatten", bottoms, name)
+            return self._add(name, "Reshape", bottoms, name, {
+                "reshape_param": {"shape": {
+                    "dim": [int(d) for d in m.size]}}})
+        if isinstance(m, (nn.Reshape, nn.View)):
+            sizes = m.size if isinstance(m, nn.Reshape) else m.sizes
+            name = self._name_of(m, "reshape")
+            return self._add(name, "Reshape", bottoms, name, {
+                "reshape_param": {"shape": {
+                    "dim": [0] + [int(d) for d in sizes]}}})
+        if isinstance(m, nn.JoinTable):
+            name = self._name_of(m, "concat")
+            return self._add(name, "Concat", bottoms, name,
+                             {"concat_param": {"axis": int(m.dim)}})
+        if isinstance(m, nn.CAddTable):
+            name = self._name_of(m, "eltwise")
+            return self._add(name, "Eltwise", bottoms, name,
+                             {"eltwise_param": {"operation": _Enum("SUM")}})
+        if isinstance(m, nn.CSubTable):
+            name = self._name_of(m, "eltwise")
+            return self._add(name, "Eltwise", bottoms, name, {
+                "eltwise_param": {"operation": _Enum("SUM"),
+                                  "coeff": [1.0, -1.0]}})
+        if isinstance(m, nn.CMulTable):
+            name = self._name_of(m, "eltwise")
+            return self._add(name, "Eltwise", bottoms, name,
+                             {"eltwise_param": {"operation": _Enum("PROD")}})
+        if isinstance(m, nn.CMaxTable):
+            name = self._name_of(m, "eltwise")
+            return self._add(name, "Eltwise", bottoms, name,
+                             {"eltwise_param": {"operation": _Enum("MAX")}})
+        if isinstance(m, nn.Identity):
+            return bottoms[0]
+        raise NotImplementedError(
+            f"CaffePersister: no emitter for {type(m).__name__} "
+            f"(register one via customized_emitters)")
+
+    def _fused_sequential(self, seq, bottoms: List[str]) -> Optional[str]:
+        """Recognize the loader's composite emissions so they round-trip
+        as ONE caffe layer: [InferReshape(0,-1), Linear] -> InnerProduct,
+        [CMul, CAdd] -> Scale(+bias)."""
+        import bigdl_tpu.nn as nn
+
+        ch = seq.layers
+        if len(ch) == 2 and isinstance(ch[0], nn.InferReshape) \
+                and tuple(ch[0].size) == (0, -1) \
+                and isinstance(ch[1], nn.Linear):
+            return self._emit(ch[1], bottoms)
+        if len(ch) == 2 and isinstance(ch[0], nn.CMul) \
+                and isinstance(ch[1], nn.CAdd):
+            name = self._name_of(ch[0], "scale")
+            return self._add(name, "Scale", bottoms, name,
+                             {"scale_param": {"bias_term": True}},
+                             [np.asarray(ch[0].weight),
+                              np.asarray(ch[1].bias)])
+        return None
+
+    def _emit_graph(self, graph, bottoms: List[str]) -> str:
+        """DAG walk: graph input nodes bind to ``bottoms`` in order."""
+        tops: Dict[int, str] = {}
+        free = list(bottoms)
+        for node in graph.input_nodes:
+            nm = node.element.get_name() or self._fresh("data")
+            tops[node.id] = free.pop(0) if free else nm
+        for node in graph._sorted:
+            if node.id in tops:
+                continue
+            node_bottoms = [tops[p.id] for p, _ in node.prev]
+            tops[node.id] = self._emit(node.element, node_bottoms)
+        outs = [tops[o.id] for o in graph.output_nodes]
+        return outs[0]
+
+    # -- output ------------------------------------------------------------
+    def build(self) -> Tuple[Dict, bytes]:
+        """(prototxt dict, caffemodel bytes)."""
+        self.layers, self.blobs, self._counter = [], {}, 0
+        net: Dict = {"name": self.net_name}
+        input_layers = []
+        data_blobs = list(self.input_shapes) or ["data"]
+        for blob in data_blobs:
+            lay = {"name": blob, "type": "Input", "top": blob}
+            if blob in self.input_shapes:
+                lay["input_param"] = {"shape": {
+                    "dim": [int(d) for d in self.input_shapes[blob]]}}
+            input_layers.append(lay)
+        self._emit(self.model, data_blobs)
+        net["layer"] = input_layers + self.layers
+        payload = pw.emit_bytes(1, self.net_name.encode())
+        for lay in self.layers:
+            payload += pw.emit_bytes(100, _layer_param(
+                lay["name"], lay["type"], lay["bottom"],
+                [lay["top"]], self.blobs.get(lay["name"], [])))
+        return net, payload
+
+    def save(self, prototxt_path: str, caffemodel_path: str,
+             overwrite: bool = False) -> None:
+        net, payload = self.build()
+        from bigdl_tpu.utils.file import save as file_save
+
+        file_save(to_prototxt(net).encode(), prototxt_path, overwrite)
+        file_save(payload, caffemodel_path, overwrite)
+
+
+def save_caffe(model, prototxt_path: str, caffemodel_path: str,
+               input_shapes=None, overwrite: bool = False) -> None:
+    """Persist ``model`` as Caffe prototxt + caffemodel
+    (``CaffePersister.scala:47``)."""
+    CaffePersister(model, input_shapes).save(prototxt_path, caffemodel_path,
+                                             overwrite)

@@ -1,0 +1,557 @@
+"""Torch7 ``.t7`` binary serialization — read/write WITHOUT any Torch
+installation (the reference capability: ``utils/TorchFile.scala:67``,
+SURVEY §2.2).  Complements ``utils/torch_interop.py`` (live-PyTorch
+conversion): this module speaks the *file format* itself.
+
+The format (public, defined by torch7's ``File:writeObject``): a stream of
+little-endian records, each ``int32 type-tag`` + payload:
+
+====  =========  ====================================================
+tag   kind       payload
+====  =========  ====================================================
+0     nil        —
+1     number     float64
+2     string     int32 length + bytes
+3     table      int32 memo-index, then int32 n + n (key, value) pairs
+4     torch obj  int32 memo-index, then version string ``V 1`` +
+                 class-name string (legacy files omit the version), then
+                 class-specific payload
+5     boolean    int32 0/1
+====  =========  ====================================================
+
+Torch classes handled natively: ``torch.{Float,Double,Long,Byte,Int}Tensor``
+(int32 ndim, int64 sizes, int64 strides, int64 1-based storage offset,
+then the storage object) and their Storages (int64 count + raw elements).
+``nn.*`` classes are converted to/from bigdl_tpu modules by the table at
+the bottom; unknown classes load as :class:`TorchObject` so callers can
+inspect them.
+
+Memo indices are shared between tables and torch objects; re-references
+resolve to the same Python object (shared storages round-trip)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["load_torch", "save_torch", "TorchObject", "TorchTensor"]
+
+TYPE_NIL, TYPE_NUMBER, TYPE_STRING, TYPE_TABLE, TYPE_TORCH, TYPE_BOOLEAN = \
+    0, 1, 2, 3, 4, 5
+
+_STORAGE_DTYPES = {
+    "torch.FloatStorage": np.float32,
+    "torch.DoubleStorage": np.float64,
+    "torch.LongStorage": np.int64,
+    "torch.IntStorage": np.int32,
+    "torch.ByteStorage": np.uint8,
+    "torch.CharStorage": np.int8,
+    "torch.ShortStorage": np.int16,
+}
+_TENSOR_TO_STORAGE = {
+    "torch.FloatTensor": "torch.FloatStorage",
+    "torch.DoubleTensor": "torch.DoubleStorage",
+    "torch.LongTensor": "torch.LongStorage",
+    "torch.IntTensor": "torch.IntStorage",
+    "torch.ByteTensor": "torch.ByteStorage",
+    "torch.CharTensor": "torch.CharStorage",
+    "torch.ShortTensor": "torch.ShortStorage",
+}
+_DTYPE_TO_TENSOR = {
+    np.dtype(np.float32): "torch.FloatTensor",
+    np.dtype(np.float64): "torch.DoubleTensor",
+    np.dtype(np.int64): "torch.LongTensor",
+    np.dtype(np.int32): "torch.IntTensor",
+    np.dtype(np.uint8): "torch.ByteTensor",
+    np.dtype(np.int8): "torch.CharTensor",
+    np.dtype(np.int16): "torch.ShortTensor",
+}
+
+
+class TorchObject:
+    """An unconverted ``torch.*``/``nn.*`` object: class name + field
+    table (or raw payload for unknown storages)."""
+
+    def __init__(self, torch_class: str, table: Optional[Dict] = None):
+        self.torch_class = torch_class
+        self.table = table if table is not None else {}
+
+    def __repr__(self):
+        return f"TorchObject({self.torch_class}, {list(self.table)})"
+
+
+class TorchTensor:
+    """A strided view over a (possibly shared) storage; ``array`` gives
+    the dense ndarray."""
+
+    def __init__(self, storage: Optional[np.ndarray], sizes, strides,
+                 offset: int):
+        self.storage, self.offset = storage, offset  # offset is 0-based
+        self.sizes, self.strides = tuple(sizes), tuple(strides)
+
+    @property
+    def array(self) -> np.ndarray:
+        if self.storage is None or not self.sizes:
+            return np.zeros((0,), np.float32)
+        itemsize = self.storage.dtype.itemsize
+        return np.lib.stride_tricks.as_strided(
+            self.storage[self.offset:],
+            self.sizes, [s * itemsize for s in self.strides]).copy()
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+class _Reader:
+    def __init__(self, buf: bytes, convert_modules: bool):
+        self.buf, self.pos = buf, 0
+        self.memo: Dict[int, Any] = {}
+        self.convert = convert_modules
+
+    def _take(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise EOFError("truncated .t7 file")
+        self.pos += n
+        return b
+
+    def _i32(self) -> int:
+        return struct.unpack("<i", self._take(4))[0]
+
+    def _i64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def _f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def _string(self) -> str:
+        return self._take(self._i32()).decode("utf-8", "replace")
+
+    def read(self) -> Any:
+        tag = self._i32()
+        if tag == TYPE_NIL:
+            return None
+        if tag == TYPE_NUMBER:
+            v = self._f64()
+            return int(v) if v.is_integer() else v
+        if tag == TYPE_STRING:
+            return self._string()
+        if tag == TYPE_BOOLEAN:
+            return self._i32() != 0
+        if tag == TYPE_TABLE:
+            return self._read_table()
+        if tag == TYPE_TORCH:
+            return self._read_torch()
+        raise ValueError(f".t7 parse error: unknown type tag {tag}")
+
+    def _read_table(self):
+        idx = self._i32()
+        if idx in self.memo:
+            return self.memo[idx]
+        table: Dict = {}
+        self.memo[idx] = table
+        n = self._i32()
+        for _ in range(n):
+            k = self.read()
+            table[k] = self.read()
+        return table
+
+    def _read_torch(self):
+        idx = self._i32()
+        if idx in self.memo:
+            return self.memo[idx]
+        # version + class name are RAW strings (length + bytes, untagged)
+        version = self._string()
+        if version.startswith("V "):
+            cls = self._string()
+        else:
+            cls = version  # legacy: no version record
+        if cls in _TENSOR_TO_STORAGE:
+            nd = self._i32()
+            sizes = [self._i64() for _ in range(nd)]
+            strides = [self._i64() for _ in range(nd)]
+            offset = self._i64() - 1
+            tensor = TorchTensor(None, sizes, strides, max(offset, 0))
+            self.memo[idx] = tensor
+            storage = self.read()
+            tensor.storage = storage
+            return tensor
+        if cls in _STORAGE_DTYPES:
+            dt = np.dtype(_STORAGE_DTYPES[cls]).newbyteorder("<")
+            n = self._i64()
+            arr = np.frombuffer(self._take(n * dt.itemsize), dt).astype(
+                _STORAGE_DTYPES[cls])
+            self.memo[idx] = arr
+            return arr
+        obj = TorchObject(cls)
+        self.memo[idx] = obj
+        payload = self.read()
+        obj.table = payload if isinstance(payload, dict) else {"_": payload}
+        if self.convert and cls.startswith("nn."):
+            converted = _to_module(obj)
+            if converted is not None:
+                self.memo[idx] = converted
+                return converted
+        return obj
+
+
+# ---------------------------------------------------------------------------
+# writing
+# ---------------------------------------------------------------------------
+
+class _Writer:
+    def __init__(self):
+        self.out: List[bytes] = []
+        self.memo: Dict[int, int] = {}   # id(obj) -> index
+        self.keep: List[Any] = []        # prevent id reuse under gc
+        self.next_index = 1
+
+    def _i32(self, v: int):
+        self.out.append(struct.pack("<i", v))
+
+    def _i64(self, v: int):
+        self.out.append(struct.pack("<q", v))
+
+    def _string(self, s: str):
+        b = s.encode()
+        self._i32(len(b))
+        self.out.append(b)
+
+    def _memoize(self, obj) -> Optional[int]:
+        """Returns the existing index (and writes it) or None if new."""
+        key = id(obj)
+        if key in self.memo:
+            self._i32(self.memo[key])
+            return self.memo[key]
+        self.memo[key] = self.next_index
+        self.keep.append(obj)
+        self._i32(self.next_index)
+        self.next_index += 1
+        return None
+
+    def write(self, obj: Any):
+        import jax
+
+        if obj is None:
+            self._i32(TYPE_NIL)
+        elif isinstance(obj, bool):
+            self._i32(TYPE_BOOLEAN)
+            self._i32(1 if obj else 0)
+        elif isinstance(obj, (int, float)):
+            self._i32(TYPE_NUMBER)
+            self.out.append(struct.pack("<d", float(obj)))
+        elif isinstance(obj, str):
+            self._i32(TYPE_STRING)
+            self._string(obj)
+        elif isinstance(obj, (np.ndarray, jax.Array, TorchTensor)):
+            self._write_tensor(obj)
+        elif isinstance(obj, dict):
+            self._i32(TYPE_TABLE)
+            if self._memoize(obj) is None:
+                self._i32(len(obj))
+                for k, v in obj.items():
+                    self.write(k)
+                    self.write(v)
+        elif isinstance(obj, (list, tuple)):
+            # Lua array-table: 1-based integer keys
+            self._i32(TYPE_TABLE)
+            if self._memoize(obj) is None:
+                self._i32(len(obj))
+                for i, v in enumerate(obj):
+                    self.write(i + 1)
+                    self.write(v)
+        elif isinstance(obj, TorchObject):
+            self._i32(TYPE_TORCH)
+            if self._memoize(obj) is None:
+                self._string("V 1")
+                self._string(obj.torch_class)
+                self.write(obj.table)
+        else:
+            module = _from_module(obj)
+            if module is None:
+                raise TypeError(f"cannot serialize {type(obj).__name__} "
+                                "to .t7")
+            self.write(module)
+
+    def _write_tensor(self, obj):
+        if isinstance(obj, TorchTensor):
+            arr = obj.array
+        else:
+            arr = np.asarray(obj)
+        if arr.dtype == np.float16 or str(arr.dtype) == "bfloat16":
+            arr = arr.astype(np.float32)
+        if arr.dtype not in _DTYPE_TO_TENSOR:
+            arr = arr.astype(np.float32)
+        cls = _DTYPE_TO_TENSOR[arr.dtype]
+        self._i32(TYPE_TORCH)
+        if self._memoize(obj) is not None:
+            return
+        self._string("V 1")
+        self._string(cls)
+        arr = np.ascontiguousarray(arr)
+        self._i32(arr.ndim)
+        for s in arr.shape:
+            self._i64(s)
+        strides = [int(s // arr.itemsize) for s in arr.strides]
+        for s in strides:
+            self._i64(s)
+        self._i64(1)  # storage offset, 1-based
+        # the storage object
+        self._i32(TYPE_TORCH)
+        storage_key = object()  # storages are written per-tensor
+        if self._memoize(storage_key) is None:
+            self._string("V 1")
+            self._string(_TENSOR_TO_STORAGE[cls])
+            self._i64(arr.size)
+            self.out.append(arr.tobytes())
+
+
+# ---------------------------------------------------------------------------
+# nn.* <-> bigdl_tpu module conversion
+# ---------------------------------------------------------------------------
+
+def _arr(v) -> Optional[np.ndarray]:
+    if isinstance(v, TorchTensor):
+        return v.array
+    if isinstance(v, np.ndarray):
+        return v
+    return None
+
+
+def _to_module(obj: TorchObject):
+    """nn.<Class> table -> bigdl_tpu module, or None when unknown."""
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+
+    t = obj.table
+    cls = obj.torch_class.split(".", 1)[1]
+
+    def modules():
+        mods = t.get("modules", {})
+        items = sorted(((k, v) for k, v in mods.items()
+                        if isinstance(k, int)), key=lambda kv: kv[0])
+        return [v for _, v in items]
+
+    if cls == "Sequential":
+        seq = nn.Sequential()
+        for m in modules():
+            seq.add(m)
+        return seq
+    if cls == "Concat":
+        c = nn.Concat(int(t.get("dimension", 2)) - 1)
+        for m in modules():
+            c.add(m)
+        return c
+    if cls == "ConcatTable":
+        c = nn.ConcatTable()
+        for m in modules():
+            c.add(m)
+        return c
+    if cls == "CAddTable":
+        return nn.CAddTable()
+    if cls == "JoinTable":
+        return nn.JoinTable(int(t.get("dimension", 2)) - 1, 0)
+    if cls == "Linear":
+        w, b = _arr(t.get("weight")), _arr(t.get("bias"))
+        m = nn.Linear(w.shape[1], w.shape[0], with_bias=b is not None)
+        m.weight = jnp.asarray(w, jnp.float32)
+        if b is not None:
+            m.bias = jnp.asarray(b, jnp.float32)
+        return m
+    if cls == "SpatialConvolution":
+        kw, kh = int(t["kW"]), int(t["kH"])
+        groups = int(t.get("groups", 1))
+        m = nn.SpatialConvolution(
+            int(t["nInputPlane"]), int(t["nOutputPlane"]), kw, kh,
+            int(t.get("dW", 1)), int(t.get("dH", 1)),
+            int(t.get("padW", 0)), int(t.get("padH", 0)), n_group=groups)
+        w = _arr(t.get("weight"))
+        m.weight = jnp.asarray(
+            w.reshape(m.n_output_plane, m.n_input_plane // groups, kh, kw),
+            jnp.float32)
+        b = _arr(t.get("bias"))
+        if b is not None:
+            m.bias = jnp.asarray(b, jnp.float32)
+        return m
+    if cls == "SpatialMaxPooling":
+        m = nn.SpatialMaxPooling(
+            int(t["kW"]), int(t["kH"]), int(t.get("dW", 1)),
+            int(t.get("dH", 1)), int(t.get("padW", 0)), int(t.get("padH", 0)))
+        if t.get("ceil_mode"):
+            m.ceil()
+        return m
+    if cls == "SpatialAveragePooling":
+        return nn.SpatialAveragePooling(
+            int(t["kW"]), int(t["kH"]), int(t.get("dW", 1)),
+            int(t.get("dH", 1)), int(t.get("padW", 0)), int(t.get("padH", 0)),
+            ceil_mode=bool(t.get("ceil_mode", False)),
+            count_include_pad=not bool(t.get("count_include_pad") is False))
+    if cls == "ReLU":
+        return nn.ReLU(bool(t.get("inplace", False)))
+    if cls == "Tanh":
+        return nn.Tanh()
+    if cls == "Sigmoid":
+        return nn.Sigmoid()
+    if cls == "SoftMax":
+        return nn.SoftMax()
+    if cls == "LogSoftMax":
+        return nn.LogSoftMax()
+    if cls == "Dropout":
+        return nn.Dropout(float(t.get("p", 0.5)))
+    if cls == "InferReshape":
+        size = t.get("size")
+        dims = list(size.array if isinstance(size, TorchTensor)
+                    else np.asarray(size).ravel())
+        return nn.InferReshape([int(d) for d in dims],
+                               bool(t.get("batchMode", False)))
+    if cls == "Reshape":
+        size = t.get("size")
+        dims = list(size.array if isinstance(size, TorchTensor)
+                    else np.asarray(size).ravel())
+        return nn.Reshape([int(d) for d in dims])
+    if cls == "View":
+        size = t.get("size")
+        dims = list(size.array if isinstance(size, TorchTensor)
+                    else np.asarray(size).ravel())
+        return nn.View(*[int(d) for d in dims])
+    if cls in ("SpatialBatchNormalization", "BatchNormalization"):
+        w, b = _arr(t.get("weight")), _arr(t.get("bias"))
+        n = int(t.get("nOutput", len(w) if w is not None
+                      else len(_arr(t["running_mean"]))))
+        ctor = nn.SpatialBatchNormalization \
+            if cls == "SpatialBatchNormalization" else nn.BatchNormalization
+        m = ctor(n, eps=float(t.get("eps", 1e-5)),
+                 momentum=float(t.get("momentum", 0.1)),
+                 affine=w is not None)
+        if w is not None:
+            m.weight = jnp.asarray(w, jnp.float32)
+            m.bias = jnp.asarray(b, jnp.float32)
+        rm, rv = _arr(t.get("running_mean")), _arr(t.get("running_var"))
+        if rm is not None:
+            m.running_mean = jnp.asarray(rm, jnp.float32)
+        if rv is not None:
+            m.running_var = jnp.asarray(rv, jnp.float32)
+        return m
+    if cls == "SpatialCrossMapLRN":
+        return nn.SpatialCrossMapLRN(
+            int(t.get("size", 5)), float(t.get("alpha", 1.0)),
+            float(t.get("beta", 0.75)), float(t.get("k", 1.0)))
+    return None
+
+
+def _from_module(m) -> Optional[TorchObject]:
+    """bigdl_tpu module -> nn.<Class> TorchObject, or None."""
+    import bigdl_tpu.nn as nn
+
+    def mods(children):
+        return {"modules": {i + 1: c for i, c in enumerate(children)},
+                "train": bool(m.training)}
+
+    if isinstance(m, nn.Concat):
+        return TorchObject("nn.Concat",
+                           {**mods(m.layers), "dimension": m.dim + 1})
+    if isinstance(m, nn.ConcatTable):
+        return TorchObject("nn.ConcatTable", mods(m.layers))
+    if isinstance(m, nn.Sequential):
+        return TorchObject("nn.Sequential", mods(m.layers))
+    if isinstance(m, nn.CAddTable):
+        return TorchObject("nn.CAddTable", {"train": bool(m.training)})
+    if isinstance(m, nn.JoinTable):
+        return TorchObject("nn.JoinTable", {"dimension": m.dim + 1})
+    if isinstance(m, nn.Linear):
+        t = {"weight": np.asarray(m.weight)}
+        if "bias" in m.__dict__["_params"]:
+            t["bias"] = np.asarray(m.bias)
+        return TorchObject("nn.Linear", t)
+    if type(m) in (nn.SpatialConvolution, nn.SpatialShareConvolution):
+        t = {"nInputPlane": m.n_input_plane, "nOutputPlane": m.n_output_plane,
+             "kW": m.kernel_w, "kH": m.kernel_h, "dW": m.stride_w,
+             "dH": m.stride_h, "padW": m.pad_w, "padH": m.pad_h,
+             "weight": np.asarray(m.weight)}
+        if m.n_group != 1:
+            t["groups"] = m.n_group  # no Lua-nn analogue; our reader honors it
+        if m.with_bias:
+            t["bias"] = np.asarray(m.bias)
+        return TorchObject("nn.SpatialConvolution", t)
+    if isinstance(m, nn.SpatialAveragePooling):
+        return TorchObject("nn.SpatialAveragePooling", {
+            "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
+            "padW": m.pad_w, "padH": m.pad_h, "ceil_mode": m.ceil_mode,
+            "count_include_pad": m.count_include_pad})
+    if isinstance(m, nn.SpatialMaxPooling):
+        return TorchObject("nn.SpatialMaxPooling", {
+            "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
+            "padW": m.pad_w, "padH": m.pad_h, "ceil_mode": m.ceil_mode})
+    if type(m) is nn.ReLU:
+        return TorchObject("nn.ReLU", {"inplace": False})
+    if type(m) is nn.Tanh:
+        return TorchObject("nn.Tanh", {})
+    if type(m) is nn.Sigmoid:
+        return TorchObject("nn.Sigmoid", {})
+    if type(m) is nn.SoftMax:
+        return TorchObject("nn.SoftMax", {})
+    if type(m) is nn.LogSoftMax:
+        return TorchObject("nn.LogSoftMax", {})
+    if isinstance(m, nn.Dropout):
+        return TorchObject("nn.Dropout", {"p": float(m.p)})
+    if isinstance(m, nn.InferReshape):
+        # no exact Lua-nn analogue (closest is dpnn); round-trips through
+        # our own reader, like the reference writes BigDL-only layers
+        return TorchObject("nn.InferReshape", {
+            "size": np.asarray(m.size, np.int64),
+            "batchMode": bool(m.batch_mode)})
+    if isinstance(m, nn.Reshape):
+        return TorchObject("nn.Reshape", {
+            "size": np.asarray(m.size, np.int64),
+            "nelement": int(np.prod(m.size))})
+    if isinstance(m, nn.View):
+        return TorchObject("nn.View", {
+            "size": np.asarray(m.sizes, np.int64),
+            "numElements": int(np.prod(m.sizes))})
+    if isinstance(m, (nn.SpatialBatchNormalization, nn.BatchNormalization)):
+        cls = "nn.SpatialBatchNormalization" \
+            if isinstance(m, nn.SpatialBatchNormalization) \
+            else "nn.BatchNormalization"
+        t = {"nOutput": m.n_output, "eps": float(m.eps),
+             "momentum": float(m.momentum), "affine": bool(m.affine),
+             "running_mean": np.asarray(m.running_mean),
+             "running_var": np.asarray(m.running_var),
+             "train": bool(m.training)}
+        if m.affine:
+            t["weight"] = np.asarray(m.weight)
+            t["bias"] = np.asarray(m.bias)
+        return TorchObject(cls, t)
+    if isinstance(m, nn.SpatialCrossMapLRN):
+        return TorchObject("nn.SpatialCrossMapLRN", {
+            "size": m.size, "alpha": float(m.alpha), "beta": float(m.beta),
+            "k": float(m.k)})
+    return None
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def load_torch(path: str, convert_modules: bool = True):
+    """Load a ``.t7`` file (``TorchFile.scala:79 load``).  ``nn.*`` objects
+    convert to bigdl_tpu modules when possible; tensors become
+    :class:`TorchTensor` (``.array`` for the ndarray); tables become
+    dicts."""
+    from bigdl_tpu.utils.file import load as file_load
+
+    r = _Reader(file_load(path), convert_modules)
+    return r.read()
+
+
+def save_torch(obj, path: str, overwrite: bool = False):
+    """Save a module / tensor / number / table to ``.t7``
+    (``TorchFile.scala:90 save``)."""
+    from bigdl_tpu.utils.file import save as file_save
+
+    w = _Writer()
+    w.write(obj)
+    file_save(b"".join(w.out), path, overwrite)

@@ -239,3 +239,115 @@ layer { name: "cat2" type: "Concat" bottom: "d" bottom: "c1" top: "cat"
     model2 = load_caffe(proto2).evaluate()
     out2 = model2.forward(x)
     assert out2.shape == (1, 2, 8, 4)  # concat along axis 2
+
+
+# ----------------------------- export (CaffePersister) --------------------
+
+def _roundtrip(model, input_shape, x):
+    """save -> reload with our own loader -> compare forward outputs
+    (the reference round-trip contract, ``CaffePersister.scala:47``)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.utils.caffe_persister import save_caffe
+
+    proto = tempfile.mktemp(suffix=".prototxt")
+    weights = tempfile.mktemp(suffix=".caffemodel")
+    save_caffe(model, proto, weights, input_shapes=input_shape)
+    reloaded, _, _ = CaffeLoader(proto, weights).load()
+    reloaded.evaluate()
+    model.evaluate()
+    a = np.asarray(model.forward(jnp.asarray(x)))
+    b = np.asarray(reloaded.forward(jnp.asarray(x)))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    return proto, weights
+
+
+def test_persister_sequential_cnn_roundtrip():
+    import bigdl_tpu.nn as nn
+
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1).set_name("conv1"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialCrossMapLRN(3, 0.001, 0.75),
+        nn.SpatialConvolution(4, 6, 3, 3, 2, 2, 0, 0, n_group=2,
+                              with_bias=False),
+        nn.Sigmoid(),
+        nn.SpatialAveragePooling(2, 2, 1, 1),
+        nn.InferReshape([0, -1]),
+        nn.Linear(6, 5).set_name("fc"),
+        nn.SoftMax(),
+    )
+    x = np.random.RandomState(0).randn(2, 3, 12, 12).astype(np.float32)
+    proto, _ = _roundtrip(model, (1, 3, 12, 12), x)
+    # named layers keep their names in the prototxt
+    text = open(proto).read()
+    assert 'name: "conv1"' in text and 'name: "fc"' in text
+
+
+def test_persister_batchnorm_scale_roundtrip():
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+
+    bn = nn.SpatialBatchNormalization(4)
+    bn.weight = jnp.asarray(np.random.RandomState(1).rand(4) + 0.5,
+                            jnp.float32)
+    bn.bias = jnp.asarray(np.random.RandomState(2).randn(4), jnp.float32)
+    bn.running_mean = jnp.asarray(np.random.RandomState(3).randn(4),
+                                  jnp.float32)
+    bn.running_var = jnp.asarray(np.random.RandomState(4).rand(4) + 0.5,
+                                 jnp.float32)
+    model = nn.Sequential(nn.SpatialConvolution(2, 4, 1, 1), bn, nn.ReLU())
+    x = np.random.RandomState(5).randn(2, 2, 5, 5).astype(np.float32)
+    _roundtrip(model, (1, 2, 5, 5), x)
+
+
+def test_persister_graph_dag_roundtrip():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.graph import node_from_module
+
+    inp = nn.Input(name="data")
+    c1 = node_from_module(nn.SpatialConvolution(3, 4, 1, 1).set_name("b1"),
+                          [inp])
+    c2 = node_from_module(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)
+                          .set_name("b2"), [inp])
+    add = node_from_module(nn.CAddTable().set_name("sum"), [c1, c2])
+    cat = node_from_module(nn.JoinTable(1, 0).set_name("cat"), [add, c1])
+    out = node_from_module(nn.ReLU().set_name("out"), [cat])
+    model = nn.Graph([inp], [out])
+    x = np.random.RandomState(6).randn(2, 3, 6, 6).astype(np.float32)
+    _roundtrip(model, (1, 3, 6, 6), x)
+
+
+def test_persister_concat_container_and_floor_pooling():
+    import bigdl_tpu.nn as nn
+
+    model = nn.Sequential(
+        nn.Concat(1)
+        .add(nn.Sequential(nn.SpatialConvolution(2, 3, 1, 1), nn.ReLU()))
+        .add(nn.Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1),
+                           nn.SpatialConvolution(2, 2, 1, 1))),
+        nn.SpatialMaxPooling(3, 3, 2, 2),  # floor mode must round-trip
+    )
+    x = np.random.RandomState(7).randn(2, 2, 7, 7).astype(np.float32)
+    _roundtrip(model, (1, 2, 7, 7), x)
+
+
+def test_prototxt_writer_parses_back():
+    from bigdl_tpu.utils.caffe_persister import to_prototxt
+
+    net = {"name": "n", "layer": [
+        {"name": "p", "type": "Pooling", "bottom": ["d"], "top": "p",
+         "pooling_param": {"pool": "MAX", "kernel_h": 3, "kernel_w": 3,
+                           "stride_h": 2, "stride_w": 2}},
+        {"name": "e", "type": "Eltwise", "bottom": ["p", "d"], "top": "e",
+         "eltwise_param": {"operation": "SUM", "coeff": [1.0, -1.0]}},
+    ]}
+    parsed = parse_prototxt(to_prototxt(net))
+    assert parsed["name"] == "n"
+    layers = parsed["layer"]
+    assert layers[0]["bottom"] == "d"
+    assert layers[1]["bottom"] == ["p", "d"]
+    assert layers[1]["eltwise_param"]["coeff"] == [1.0, -1.0]
+    assert layers[0]["pooling_param"]["pool"] == "MAX"

@@ -137,3 +137,24 @@ def test_channel_mean_std_grey():
     mean, std = im.channel_mean_std(
         iter([im.LabeledImage(x, 0.0) for x in imgs]))
     assert mean.shape == (1,) and std.shape == (1,)
+
+
+def test_movielens_loader():
+    import os
+    import tempfile
+
+    from bigdl_tpu.dataset.datasets import (load_movielens,
+                                            movielens_id_pairs,
+                                            movielens_id_ratings)
+
+    data = load_movielens()  # synthetic fallback
+    assert data.shape[1] == 4 and data.dtype.kind == "i"
+    assert movielens_id_pairs().shape[1] == 2
+    assert movielens_id_ratings().shape[1] == 3
+    # real ratings.dat parse ("::"-separated, movielens.py read_data_sets)
+    d = tempfile.mkdtemp()
+    os.makedirs(os.path.join(d, "ml-1m"))
+    with open(os.path.join(d, "ml-1m", "ratings.dat"), "w") as f:
+        f.write("1::31::4::978300019\n2::1029::5::978302205\n")
+    parsed = load_movielens(d)
+    assert parsed.tolist() == [[1, 31, 4, 978300019], [2, 1029, 5, 978302205]]

@@ -406,3 +406,15 @@ def test_shape_layers():
     assert joined.shape == (2, 12)
     infer = nn.InferReshape((0, -1), batch_mode=False).forward(x)
     assert infer.shape == (2, 12)
+
+
+def test_global_max_pooling_uses_fallback_and_matches():
+    """Window taps above the gate (global pooling) must route to the
+    reduce_window autodiff path, with identical forward results."""
+    layer = nn.SpatialMaxPooling(1, 1, global_pooling=True)
+    x_np = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    out = layer.forward(jnp.asarray(x_np))
+    np.testing.assert_allclose(np.asarray(out).reshape(2, 3),
+                               x_np.max(axis=(2, 3)))
+    g = layer.backward(jnp.asarray(x_np), jnp.ones_like(out))
+    assert float(jnp.sum(g)) == pytest.approx(6.0)
