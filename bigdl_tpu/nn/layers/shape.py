@@ -440,25 +440,39 @@ class ResizeBilinear(Module):
     """Bilinear resize of NCHW/NHWC maps (``nn/ResizeBilinear.scala``)."""
 
     def __init__(self, output_height: int, output_width: int,
-                 align_corners: bool = False, format: str = "NCHW"):
+                 align_corners: bool = False, format: str = "NCHW",
+                 half_pixel_centers: bool = False):
         super().__init__()
+        assert not (align_corners and half_pixel_centers)
         self.output_height, self.output_width = output_height, output_width
         self.align_corners = align_corners
+        self.half_pixel_centers = half_pixel_centers
         self.format = format
 
     def update_output(self, input):
-        if self.format == "NHWC":
-            shape = input.shape[:-3] + (self.output_height, self.output_width, input.shape[-1])
-        else:
-            shape = input.shape[:-2] + (self.output_height, self.output_width)
-        if not self.align_corners:
-            return jax.image.resize(input, shape, method="bilinear")
-        # align_corners: linear sample grid including both endpoints
         h_ax = input.ndim - 3 if self.format == "NHWC" else input.ndim - 2
         w_ax = h_ax + 1
         ih, iw = input.shape[h_ax], input.shape[w_ax]
-        ys = jnp.linspace(0, ih - 1, self.output_height)
-        xs = jnp.linspace(0, iw - 1, self.output_width)
+        if self.align_corners:
+            # linear sample grid including both endpoints
+            ys = jnp.linspace(0, ih - 1, self.output_height)
+            xs = jnp.linspace(0, iw - 1, self.output_width)
+        elif self.half_pixel_centers:
+            # TF2 convention: src = (dst + 0.5) * scale - 0.5, clamped
+            ys = (jnp.arange(self.output_height) + 0.5) \
+                * (ih / self.output_height) - 0.5
+            xs = (jnp.arange(self.output_width) + 0.5) \
+                * (iw / self.output_width) - 0.5
+            ys = jnp.clip(ys, 0, ih - 1)
+            xs = jnp.clip(xs, 0, iw - 1)
+        else:
+            # the reference (and TF v1's legacy kernel it mirrors) uses the
+            # asymmetric src = dst * scale convention — NOT half-pixel
+            # centers (``nn/ResizeBilinear.scala`` computeInterpolationWeights)
+            ys = jnp.arange(self.output_height) * (ih / self.output_height)
+            xs = jnp.arange(self.output_width) * (iw / self.output_width)
+            ys = jnp.minimum(ys, ih - 1)
+            xs = jnp.minimum(xs, iw - 1)
         y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, ih - 1)
         y1 = jnp.clip(y0 + 1, 0, ih - 1)
         x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, iw - 1)

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_UNSET = object()  # sentinel for __setattr__ hyper-version tracking
+
 __all__ = [
     "Parameter",
     "Module",
@@ -116,6 +118,13 @@ class Module:
             return
         if "_modules" in d and name in d["_modules"] and not isinstance(value, Module):
             del d["_modules"][name]
+        # plain-attribute (hyperparameter) edits invalidate memoized
+        # backward traces — the value may be baked into a cached jit
+        old = d.get(name, _UNSET)
+        if old is not value and not (
+                isinstance(value, (int, float, str, bool, tuple, type(None)))
+                and isinstance(old, type(value)) and old == value):
+            d["_hyper_version"] = d.get("_hyper_version", 0) + 1
         d[name] = value
 
     def __getattr__(self, name):
@@ -177,8 +186,17 @@ class Module:
     def backward(self, input, grad_output):
         """Compute ``gradInput`` and accumulate parameter gradients, via
         ``jax.vjp`` over the pure forward (replaces the reference's
-        ``updateGradInput`` + ``accGradParameters``)."""
-        from bigdl_tpu.utils.rng import current_rng_key, rng_context
+        ``updateGradInput`` + ``accGradParameters``).
+
+        The vjp is compiled and MEMOIZED per module: the trace is keyed on
+        every submodule's identity, (training, frozen) flags, and
+        hyperparameter version (bumped by ``__setattr__`` on plain-attr
+        edits); buffers ride as traced arguments; ``jax.jit`` handles
+        shape/dtype variation under each key — so a Torch-style eager loop
+        pays tracing once, matching the reference's cheap repeated
+        ``backward`` (``AbstractModule.scala:260-297``), while structural
+        or hyperparameter edits re-trace automatically."""
+        from bigdl_tpu.utils.rng import current_rng_key
 
         t0 = time.perf_counter()
         params = state_dict(self, kind="param")
@@ -188,13 +206,10 @@ class Module:
         if current_rng_key() is None:
             replay_key = self.__dict__.get("_last_rng_key")
 
-        def fn(p, inp):
-            out, _ = functional_call(self, p, inp, rng=replay_key)
-            return out
-
         # functional_call clears trace scratch (_last_rng_key, Recurrent
         # state, ...) — snapshot and restore so eager state survives
         # repeated backward calls and get_hidden_state() after backward
+        # (only the TRACE touches python state; cached replays don't)
         scratch = []
         for m in self.modules():
             entry = {}
@@ -204,16 +219,36 @@ class Module:
                 entry[attr] = m.__dict__.get(attr)
             scratch.append(entry)
 
-        out, vjp = jax.vjp(fn, params, input)
+        cache = self.__dict__.setdefault("_bwd_cache", {})
+        # key: identity + mode + frozen + hyperparameter version of every
+        # submodule (attr edits bump _hyper_version via __setattr__), so
+        # stale traces cannot be replayed; buffers are traced ARGUMENTS so
+        # e.g. BN running stats are always current
+        flags = tuple((id(m), m.training, m.__dict__["_frozen"],
+                       m.__dict__.get("_hyper_version", 0))
+                      for m in self.modules())
+        ckey = (replay_key is not None, flags)
+        buffers = state_dict(self, kind="buffer")
+        if ckey not in cache:
+            def bwd_fn(p, bufs, inp, gout, key):
+                def fn(p2, i2):
+                    out, _ = functional_call(self, {**p2, **bufs}, i2,
+                                             rng=key)
+                    return out
+
+                out, vjp = jax.vjp(fn, p, inp)
+                tangent = jax.tree.map(
+                    lambda o, g: jnp.asarray(g, o.dtype) if g is not None
+                    else jnp.zeros_like(o), out, gout)
+                return vjp(tangent)
+
+            cache.clear()  # one live trace per module keeps memory bounded
+            cache[ckey] = jax.jit(bwd_fn)
+        p_grads, grad_input = cache[ckey](params, buffers, input,
+                                          grad_output, replay_key)
         for m, entry in zip(self.modules(), scratch):
             for attr, val in entry.items():
                 m.__dict__[attr] = val
-        tangent = jax.tree.map(
-            lambda o, g: jnp.asarray(g, o.dtype) if g is not None else jnp.zeros_like(o),
-            out,
-            grad_output,
-        )
-        p_grads, grad_input = vjp(tangent)
         if not self.__dict__["_frozen"]:
             self._accumulate_grads(p_grads)
         self.__dict__["grad_input"] = grad_input

@@ -315,9 +315,11 @@ class Rank(Operation):
 class ResizeBilinearOps(Operation):
     """(images NHWC, size) -> bilinear resize (``ops/ResizeBilinearOps.scala``)."""
 
-    def __init__(self, align_corners: bool = False):
+    def __init__(self, align_corners: bool = False,
+                 half_pixel_centers: bool = False):
         super().__init__()
         self.align_corners = align_corners
+        self.half_pixel_centers = half_pixel_centers
 
     def update_output(self, input):
         from bigdl_tpu.nn.layers.shape import ResizeBilinear
@@ -325,7 +327,9 @@ class ResizeBilinearOps(Operation):
         images, size = input
         h, w = int(size[0]), int(size[1])
         return ResizeBilinear(h, w, align_corners=self.align_corners,
-                              format="NHWC").forward(images)
+                              format="NHWC",
+                              half_pixel_centers=self.half_pixel_centers
+                              ).forward(images)
 
 
 class Slice(Operation):

@@ -253,12 +253,30 @@ class Optimizer:
         t0 = time.perf_counter()
         results = None
         count = 0
-        for batch in self._val_dataset.data(train=False):
+        # multi-host: round-robin the validation batches across processes
+        # and merge collectively — the reference shards validation over
+        # the cluster the same way (optim/DistriValidator.scala:35,
+        # DistriOptimizer.scala:632) instead of evaluating the full set
+        # everywhere.  A DistributedDataSet is ALREADY per-process
+        # sharded — iterate it fully and only merge.
+        from bigdl_tpu.dataset.dataset import DistributedDataSet
+
+        nproc, pidx = Engine.process_count(), Engine.process_index()
+        presharded = isinstance(self._val_dataset, DistributedDataSet) \
+            and getattr(self._val_dataset, "num_shards", 1) > 1
+        for i, batch in enumerate(self._val_dataset.data(train=False)):
+            if nproc > 1 and not presharded and i % nproc != pidx:
+                continue
             out = eval_step.run(batch.get_input())
             target = batch.get_target()
             rs = [m(out, target) for m in self._val_methods]
             results = rs if results is None else [a + b for a, b in zip(results, rs)]
             count += batch.size()
+        if nproc > 1:
+            from bigdl_tpu.optim.validation import merge_across_processes
+
+            results = merge_across_processes(results, self._val_methods)
+            count = int(results[0].result()[1]) if results else count
         if results is None:
             return
         wall = time.perf_counter() - t0

@@ -12,6 +12,7 @@ import numpy as np
 __all__ = [
     "ValidationResult", "AccuracyResult", "LossResult", "ValidationMethod",
     "Top1Accuracy", "Top5Accuracy", "Loss", "MAE", "TreeNNAccuracy",
+    "merge_across_processes",
 ]
 
 
@@ -22,6 +23,30 @@ class ValidationResult:
     def __add__(self, other):
         raise NotImplementedError
 
+    def _state(self):
+        """(numerator, count) — the two constructor fields, used for
+        cross-process merging."""
+        raise NotImplementedError
+
+
+def merge_across_processes(results, methods):
+    """Sum per-process ValidationResults over ALL host processes — the
+    sharded-validation merge (``optim/DistriValidator.scala:35``; the
+    reference zips validation partitions across the cluster and reduces
+    with ``+``).  COLLECTIVE: every process of the cluster must call
+    this, even with zero local batches (``results=None``)."""
+    from jax.experimental import multihost_utils
+
+    if results is None:
+        state = np.zeros((len(methods), 2), np.float64)
+        kinds = [m.result_type for m in methods]
+    else:
+        state = np.asarray([r._state() for r in results], np.float64)
+        kinds = [type(r) for r in results]
+    gathered = multihost_utils.process_allgather(state)
+    totals = gathered.reshape(-1, *state.shape).sum(axis=0)
+    return [cls(a, b) for cls, (a, b) in zip(kinds, totals)]
+
 
 class AccuracyResult(ValidationResult):
     def __init__(self, correct: int, count: int):
@@ -29,6 +54,9 @@ class AccuracyResult(ValidationResult):
 
     def result(self):
         return (self.correct / max(self.count, 1), self.count)
+
+    def _state(self):
+        return (self.correct, self.count)
 
     def __add__(self, other):
         return AccuracyResult(self.correct + other.correct, self.count + other.count)
@@ -49,6 +77,9 @@ class LossResult(ValidationResult):
     def result(self):
         return (self.loss / max(self.count, 1), self.count)
 
+    def _state(self):
+        return (self.loss, self.count)
+
     def __add__(self, other):
         return LossResult(self.loss + other.loss, self.count + other.count)
 
@@ -59,6 +90,7 @@ class LossResult(ValidationResult):
 
 class ValidationMethod:
     name = "ValidationMethod"
+    result_type = AccuracyResult  # Loss/MAE override
 
     def __call__(self, output, target) -> ValidationResult:
         raise NotImplementedError
@@ -113,6 +145,7 @@ class Loss(ValidationMethod):
     """Mean criterion loss (``ValidationMethod.scala:312``)."""
 
     name = "Loss"
+    result_type = LossResult
 
     def __init__(self, criterion=None):
         if criterion is None:
@@ -132,6 +165,7 @@ class MAE(ValidationMethod):
     (``ValidationMethod.scala:332``)."""
 
     name = "MAE"
+    result_type = LossResult
 
     def __init__(self, one_based: bool = False):
         self.one_based = one_based

@@ -187,7 +187,9 @@ class _Encoder:
             self.next_id += 1
             self.memo[id(v)] = oid
             self._keepalive.append(v)
-            attrs = {k: self.value(x) for k, x in vars(v).items()}
+            # runtime-only scratch (compiled backward memos) never persists
+            attrs = {k: self.value(x) for k, x in vars(v).items()
+                     if k != "_bwd_cache"}
             return {"__t__": "obj", "c": cls.__name__, "id": oid, "a": attrs}
         raise SerializationError(
             f"cannot persist {cls.__module__}.{cls.__name__} — register it "

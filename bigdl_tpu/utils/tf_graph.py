@@ -76,7 +76,12 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
             content = val
         elif f == 5:
             floats.extend(pw.packed_floats(val, wt))
-        elif f in (6, 10):
+        elif f == 6:  # double_val
+            if wt == 2:
+                floats.extend(struct.unpack(f"<{len(val) // 8}d", val))
+            else:
+                floats.append(struct.unpack("<d", val)[0])
+        elif f in (7, 10, 11):  # int_val / int64_val / bool_val
             ints.extend(pw.packed_varints(val, wt))
         elif f == 8:  # string_val (DT_STRING tensors: filenames, keys)
             strs.append(val)
@@ -182,6 +187,7 @@ class TensorflowLoader:
         self.input_names = list(inputs)
         self.output_names = list(outputs)
         self.train_consts = train_consts
+        self._multi_output: Dict[str, int] = {}  # name -> n outputs
 
     @staticmethod
     def _clean(name: str) -> str:
@@ -202,8 +208,9 @@ class TensorflowLoader:
 
         op = node["op"]
         a = node["attrs"]
-        ins = [self._clean(i) for i in node["inputs"]
-               if not i.startswith("^")]
+        # keep ":k" output-index suffixes — build() routes them through
+        # the Graph's from_index edges (multi-output ops: Split/Unpack)
+        ins = [i for i in node["inputs"] if not i.startswith("^")]
         fmt = (a.get("data_format") or b"NHWC")
         fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
 
@@ -328,6 +335,59 @@ class TensorflowLoader:
             return nntf.Shape(), ins
         if op == "Fill":
             return nntf.Fill(), ins
+        if op == "Transpose":
+            perm = [int(p) for p in self._const_value(ins[1]).reshape(-1)]
+            return ops.ModuleToOperation(_Transpose(perm)), ins[:1]
+        if op == "Split":
+            axis = int(self._const_value(ins[0]).reshape(-1)[0])
+            num = int(a.get("num_split", 1))
+            self._multi_output[node["name"]] = num
+            return ops.ModuleToOperation(_Split(axis, num)), ins[1:]
+        if op in ("Unpack", "Unstack"):
+            axis = int(a.get("axis", 0))
+            num = int(a.get("num", 0))
+            self._multi_output[node["name"]] = num
+            return ops.ModuleToOperation(_Unpack(axis, num)), ins[:1]
+        if op in ("Pack", "Stack"):
+            axis = int(a.get("axis", 0))
+            return ops.ModuleToOperation(_Pack(axis)), ins
+        if op == "OneHot":
+            axis = int(a.get("axis", -1))
+            depth = int(self._const_value(ins[1]).reshape(-1)[0])
+            on = float(self._const_value(ins[2]).reshape(-1)[0])
+            off = float(self._const_value(ins[3]).reshape(-1)[0])
+            return ops.OneHot(axis, depth, on, off), ins[:1]
+        if op == "Slice":
+            begin = [int(b) for b in self._const_value(ins[1]).reshape(-1)]
+            size = [int(s) for s in self._const_value(ins[2]).reshape(-1)]
+            return ops.Slice(begin, size), ins[:1]
+        if op == "StridedSlice":
+            begin = [int(b) for b in self._const_value(ins[1]).reshape(-1)]
+            end = [int(e) for e in self._const_value(ins[2]).reshape(-1)]
+            strides = [int(s) for s in self._const_value(ins[3]).reshape(-1)]
+            return ops.ModuleToOperation(_StridedSlice(
+                begin, end, strides, int(a.get("begin_mask", 0)),
+                int(a.get("end_mask", 0)), int(a.get("shrink_axis_mask", 0)),
+                int(a.get("ellipsis_mask", 0)),
+                int(a.get("new_axis_mask", 0)))), ins[:1]
+        if op == "Conv2DBackpropInput":
+            strides = a.get("strides", [1, 1, 1, 1])
+            pad = a.get("padding") or b"SAME"
+            pad = pad.decode() if isinstance(pad, bytes) else pad
+            out_shape = [int(s) for s in
+                         self._const_value(ins[0]).reshape(-1)]
+            if fmt == "NHWC":
+                sh, sw = int(strides[1]), int(strides[2])
+            else:
+                sh, sw = int(strides[2]), int(strides[3])
+            return ops.ModuleToOperation(_Conv2DBackpropInput(
+                out_shape, sh, sw, pad, fmt)), ins[1:]
+        if op == "ResizeBilinear":
+            return ops.ResizeBilinearOps(
+                bool(a.get("align_corners", False)),
+                bool(a.get("half_pixel_centers", False))), ins
+        if op in ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp"):
+            return ops.DecodeImage(int(a.get("channels", 3) or 3)), ins
         if op == "Placeholder":
             return None, []
         raise NotImplementedError(
@@ -344,27 +404,171 @@ class TensorflowLoader:
             graph_nodes[self._clean(name)] = node
             inputs.append(node)
 
-        def build(name: str) -> Node:
-            name = self._clean(name)
-            if name in graph_nodes:
-                return graph_nodes[name]
-            nd = self.nodes.get(name)
-            if nd is None:
-                raise KeyError(f"unknown node {name!r}")
-            mod, ins = self._convert(nd, graph_nodes, inputs)
-            if mod is None:  # placeholder not listed as input
-                node = nn.Input(name=name)
-                inputs.append(node)
-                graph_nodes[name] = node
-                return node
-            mod.set_name(name)
-            src = [build(i) for i in ins]
-            node = node_from_module(mod, src) if src else Node(mod)
-            graph_nodes[name] = node
+        def build(ref: str):
+            """Build the node for ``ref``; multi-output refs ("name:k",
+            or any consumer of Split/Unpack) return (node, k) pairs that
+            node_from_module turns into from_index edges."""
+            name = self._clean(ref)
+            _, _, suffix = ref.lstrip("^").partition(":")
+            out_idx = int(suffix) if suffix.isdigit() else 0
+            if name not in graph_nodes:
+                nd = self.nodes.get(name)
+                if nd is None:
+                    raise KeyError(f"unknown node {name!r}")
+                mod, ins = self._convert(nd, graph_nodes, inputs)
+                if mod is None:  # placeholder not listed as input
+                    node = nn.Input(name=name)
+                    inputs.append(node)
+                    graph_nodes[name] = node
+                else:
+                    mod.set_name(name)
+                    src = [build(i) for i in ins]
+                    graph_nodes[name] = (node_from_module(mod, src)
+                                         if src else Node(mod))
+            node = graph_nodes[name]
+            if name in self._multi_output:
+                return (node, out_idx)
             return node
 
-        outputs = [build(n) for n in self.output_names]
+        def as_node(ref: str) -> Node:
+            built = build(ref)
+            if isinstance(built, tuple):  # multi-output graph output:
+                src, idx = built         # select via a routing identity
+                sel = node_from_module(nn.Identity(), [(src, idx)])
+                return sel
+            return built
+
+        outputs = [as_node(n) for n in self.output_names]
         return nn.Graph(inputs, outputs)
+
+
+class _Transpose:
+    def __init__(self, perm):
+        self.perm = tuple(perm)
+
+    def forward(self, input):
+        import jax.numpy as jnp
+
+        return jnp.transpose(input, self.perm)
+
+
+class _Split:
+    """TF Split: equal chunks along axis; a MULTI-OUTPUT node (list)."""
+
+    def __init__(self, axis, num):
+        self.axis, self.num = axis, num
+
+    def forward(self, input):
+        import jax.numpy as jnp
+
+        return list(jnp.split(input, self.num, axis=self.axis))
+
+
+class _Unpack:
+    """TF Unpack/Unstack: split + squeeze along axis (multi-output)."""
+
+    def __init__(self, axis, num):
+        self.axis, self.num = axis, num
+
+    def forward(self, input):
+        import jax.numpy as jnp
+
+        num = self.num or input.shape[self.axis]
+        return [jnp.squeeze(s, self.axis)
+                for s in jnp.split(input, num, axis=self.axis)]
+
+
+class _Pack:
+    def __init__(self, axis):
+        self.axis = axis
+
+    def forward(self, input):
+        import jax.numpy as jnp
+
+        parts = input if isinstance(input, (list, tuple)) else [input]
+        return jnp.stack(parts, axis=self.axis)
+
+
+class _StridedSlice:
+    """TF StridedSlice with begin/end/shrink-axis masks (the subset the
+    reference's loader handles, ``utils/tf/loaders/StridedSlice.scala``);
+    ellipsis/new-axis masks are rejected explicitly."""
+
+    def __init__(self, begin, end, strides, begin_mask, end_mask,
+                 shrink_mask, ellipsis_mask, new_axis_mask):
+        if ellipsis_mask or new_axis_mask:
+            raise NotImplementedError(
+                "StridedSlice ellipsis_mask/new_axis_mask is unsupported")
+        self.begin, self.end, self.strides = begin, end, strides
+        self.begin_mask, self.end_mask = begin_mask, end_mask
+        self.shrink_mask = shrink_mask
+
+    def forward(self, input):
+        import jax.numpy as jnp
+
+        slices = []
+        shrink = []
+        for i in range(input.ndim):
+            if i >= len(self.begin):
+                slices.append(slice(None))
+                continue
+            b = None if self.begin_mask & (1 << i) else self.begin[i]
+            e = None if self.end_mask & (1 << i) else self.end[i]
+            if self.shrink_mask & (1 << i):
+                b0 = self.begin[i]
+                slices.append(slice(b0, b0 + 1 if b0 != -1 else None))
+                shrink.append(i)
+            else:
+                slices.append(slice(b, e, self.strides[i]))
+        out = input[tuple(slices)]
+        for ax in reversed(shrink):
+            out = jnp.squeeze(out, ax)
+        return out
+
+
+class _Conv2DBackpropInput:
+    """TF transposed conv (gradient-of-conv used as a forward op, e.g.
+    deconvolution layers; ``utils/tf/loaders/Conv2DBackpropInput.scala``).
+    Inputs: (filter HWIO, out_backprop)."""
+
+    def __init__(self, out_shape, sh, sw, padding, fmt):
+        self.out_shape = tuple(out_shape)
+        self.sh, self.sw = sh, sw
+        self.padding, self.fmt = padding, fmt
+
+    def forward(self, input):
+        import jax.numpy as jnp
+        from jax import lax
+
+        w, y = input
+        if self.fmt == "NCHW":
+            y = y.transpose(0, 2, 3, 1)
+        out_h = self.out_shape[1] if self.fmt == "NHWC" else self.out_shape[2]
+        out_w = self.out_shape[2] if self.fmt == "NHWC" else self.out_shape[3]
+        kh, kw = int(w.shape[0]), int(w.shape[1])
+        # effective padding of the FORWARD conv this op inverts
+        if self.padding == "SAME":
+            pad_h = max(0, (-(-out_h // self.sh) - 1) * self.sh + kh - out_h)
+            pad_w = max(0, (-(-out_w // self.sw) - 1) * self.sw + kw - out_w)
+            pads = [(pad_h // 2, pad_h - pad_h // 2),
+                    (pad_w // 2, pad_w - pad_w // 2)]
+        else:
+            pads = [(0, 0), (0, 0)]
+        dn = lax.conv_dimension_numbers(
+            (1, out_h, out_w, 1), w.shape, ("NHWC", "HWIO", "NHWC"))
+        # transpose of the forward conv: dilate the grads by the stride,
+        # pad by kernel-1 minus forward padding, flip + swap the filter
+        wt = jnp.flip(jnp.swapaxes(w, 2, 3), axis=(0, 1))
+        out = lax.conv_general_dilated(
+            y, wt.astype(y.dtype), (1, 1),
+            [(kh - 1 - pads[0][0], kh - 1 - pads[0][1]
+              + (out_h + sum(pads[0]) - kh) % self.sh),
+             (kw - 1 - pads[1][0], kw - 1 - pads[1][1]
+              + (out_w + sum(pads[1]) - kw) % self.sw)],
+            lhs_dilation=(self.sh, self.sw), dimension_numbers=dn)
+        if self.fmt == "NCHW":
+            out = out.transpose(0, 3, 1, 2)
+        return out
 
 
 class _MatMul:

@@ -155,16 +155,11 @@ class TFTrainingSession:
         queue = self._follow_identity(deq["inputs"][0])
         enq = self._find_enqueue(queue["name"])
         filenames: Optional[List[str]] = None
-        comps: List[Tuple[str, object, List[int]]] = []
+        comps: List[Tuple[str, object, List[int], List]] = []
         for ref in enq["inputs"][1:]:
             if ref.startswith("^"):  # control dep, not a data component
                 continue
-            name, port = _split_ref(ref)
-            src = self._follow_identity(ref)
-            if src["op"] not in _PARSE_OPS:
-                raise NotImplementedError(
-                    f"enqueued component from {src['op']} unsupported "
-                    f"(want ParseExample*)")
+            src, port, chain = self._component_chain(ref)
             keys, dtypes, shapes, first_dense = self._dense_spec(src)
             di = port - first_dense
             if not 0 <= di < len(keys):
@@ -172,7 +167,7 @@ class TFTrainingSession:
                     f"component port {port} is not a dense output")
             dtype = dtypes[di] if di < len(dtypes) else np.float32
             shape = list(shapes[di]) if di < len(shapes) else []
-            comps.append((keys[di], dtype, shape))
+            comps.append((keys[di], dtype, shape, chain))
             files = self._serialized_source(src)
             if filenames is None:
                 filenames = files
@@ -181,6 +176,119 @@ class TFTrainingSession:
         if filenames is None:
             raise ValueError(f"dequeue {dequeue_name!r} has no components")
         return filenames, comps
+
+    #: per-record host ops allowed between ParseExample and the enqueue —
+    #: the image-decode pipelines of ``Session.scala:173-263``
+    _HOST_OPS = {"DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp",
+                 "DecodeRaw", "Cast", "Reshape", "ExpandDims", "Squeeze",
+                 "Sub", "Add", "AddV2", "Mul", "RealDiv", "Div",
+                 "ResizeBilinear"}
+
+    def _component_chain(self, ref: str):
+        """Walk one enqueue component back to its ParseExample output,
+        collecting the host-op chain as compiled per-record CLOSURES in
+        APPLICATION order (consts resolved ONCE, not per record).
+        Returns (parse_node, parse_port, [fn(value) -> value, ...])."""
+        chain = []
+        cur = ref
+        while True:
+            # step Identity hops one at a time so the ":port" suffix of
+            # the ref that directly names the parse op is preserved
+            name, port = _split_ref(cur)
+            src = self.by_name.get(name)
+            if src is None:
+                raise KeyError(f"unknown node {name!r}")
+            if src["op"] in ("Identity", "StopGradient"):
+                cur = [i for i in src["inputs"]
+                       if not i.startswith("^")][0]
+                continue
+            if src["op"] in _PARSE_OPS:
+                chain.reverse()
+                return src, port, chain
+            if src["op"] not in self._HOST_OPS:
+                raise NotImplementedError(
+                    f"enqueued component from {src['op']} unsupported "
+                    f"(want ParseExample* or host ops "
+                    f"{sorted(self._HOST_OPS)})")
+            data_ins = [i for i in src["inputs"] if not i.startswith("^")]
+            data_idx = 0
+            if len(data_ins) > 1 and \
+                    self._follow_identity(data_ins[0])["op"] == "Const":
+                data_idx = 1
+            chain.append(self._compile_host_op(src, data_idx))
+            cur = data_ins[data_idx]
+
+    def _const_of(self, ref: str) -> np.ndarray:
+        node = self._follow_identity(ref)
+        if node["op"] != "Const":
+            raise NotImplementedError(
+                f"expected Const operand, got {node['op']}")
+        return np.asarray(node["attrs"]["value"])
+
+    def _compile_host_op(self, node: Dict, data_idx: int):
+        """Turn one pipeline node into a per-record closure; Const
+        operands and helper modules are resolved HERE, once."""
+        op = node["op"]
+        a = node["attrs"]
+        ins = [i for i in node["inputs"] if not i.startswith("^")]
+        if op in ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp"):
+            channels = int(a.get("channels", 3) or 3)
+            mode = {1: "L", 3: "RGB", 4: "RGBA"}[channels]
+
+            def decode(value):
+                import io
+
+                from PIL import Image
+
+                arr = np.asarray(Image.open(io.BytesIO(bytes(value)))
+                                 .convert(mode))
+                return arr if arr.ndim == 3 else arr[:, :, None]
+
+            return decode
+        if op == "DecodeRaw":
+            dt = a.get("out_type")
+            dt = _TF_DTYPES.get(dt[1] if isinstance(dt, tuple) else dt,
+                                np.uint8)
+            return lambda value: np.frombuffer(bytes(value), dt).copy()
+        if op == "Cast":
+            dt = a.get("DstT")
+            dt = _TF_DTYPES.get(dt[1] if isinstance(dt, tuple) else dt,
+                                np.float32)
+            return lambda value: np.asarray(value).astype(dt)
+        if op == "Reshape":
+            shape = [int(s) for s in self._const_of(ins[1]).reshape(-1)]
+            return lambda value: np.asarray(value).reshape(shape)
+        if op == "ExpandDims":
+            axis = int(self._const_of(ins[1]).reshape(-1)[0])
+            return lambda value: np.expand_dims(np.asarray(value), axis)
+        if op == "Squeeze":
+            dims = tuple(int(d) for d in (a.get("squeeze_dims") or []))
+            return lambda value: np.squeeze(np.asarray(value), dims or None)
+        if op == "ResizeBilinear":
+            from bigdl_tpu.nn.layers.shape import ResizeBilinear
+
+            size = self._const_of(ins[1]).reshape(-1)
+            resize = ResizeBilinear(
+                int(size[0]), int(size[1]),
+                bool(a.get("align_corners", False)), format="NHWC",
+                half_pixel_centers=bool(a.get("half_pixel_centers", False)))
+            return lambda value: np.asarray(
+                resize.forward(np.asarray(value, np.float32)[None]))[0]
+        if op in ("Sub", "Add", "AddV2", "Mul", "RealDiv", "Div"):
+            other = self._const_of(ins[1 - data_idx]).astype(np.float32)
+
+            def arith(value):
+                v = np.asarray(value, np.float32)
+                if op == "Sub":
+                    return v - other if data_idx == 0 else other - v
+                if op in ("Add", "AddV2"):
+                    return v + other
+                if op == "Mul":
+                    return v * other
+                return v / other if data_idx == 0 else other / v
+
+            return arith
+        raise NotImplementedError(op)
 
     def _walk_compute(self, output_names: Sequence[str]):
         """One ancestor walk of ``outputs``: (compute-node keep set,
@@ -204,8 +312,8 @@ class TFTrainingSession:
         return seen, dequeues
 
     # -- dataset construction ---------------------------------------------
-    @staticmethod
-    def _records(filenames: List[str], comps) -> List[Tuple[np.ndarray, ...]]:
+    def _records(self, filenames: List[str], comps
+                 ) -> List[Tuple[np.ndarray, ...]]:
         from bigdl_tpu.dataset.tfrecord import TFRecordIterator, parse_example
 
         out = []
@@ -213,17 +321,30 @@ class TFTrainingSession:
             for rec in TFRecordIterator(path):
                 feats = parse_example(rec)
                 row = []
-                for key, dtype, shape in comps:
+                for key, dtype, shape, chain in comps:
                     if key not in feats:
                         raise KeyError(f"record missing feature {key!r}")
                     v = feats[key]
                     if isinstance(v, list):  # bytes feature
-                        raise NotImplementedError(
-                            f"bytes feature {key!r} unsupported in training "
-                            f"pipeline")
-                    arr = np.asarray(v).astype(dtype)
-                    row.append(arr.reshape(shape) if shape else
+                        # scalar bytes (e.g. an encoded image) stays raw
+                        # for the decode chain; lists of bytes have no
+                        # dense-tensor representation here
+                        if len(v) != 1:
+                            raise NotImplementedError(
+                                f"multi-value bytes feature {key!r}")
+                        v = v[0]
+                        if not chain:
+                            raise NotImplementedError(
+                                f"bytes feature {key!r} reaches the queue "
+                                "undecoded (no Decode* op in its chain)")
+                    for fn in chain:
+                        v = fn(v)
+                    arr = np.asarray(v)
+                    if not chain:  # raw dense feature: apply declared spec
+                        arr = arr.astype(dtype)
+                        arr = (arr.reshape(shape) if shape else
                                (arr.reshape(()) if arr.size == 1 else arr))
+                    row.append(arr)
                 out.append(tuple(row))
         return out
 

@@ -80,6 +80,12 @@ def main():
     o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
     if os.environ.get("BIGDL_TEST_ZERO1"):
         o.set_parameter_sync("sharded")
+    if os.environ.get("BIGDL_TEST_SHARDED_VAL"):
+        # validation batches round-robin across processes; the merged
+        # result must equal the single-process full evaluation
+        o.set_validation(optim.Trigger.several_iteration(4), samples,
+                         [optim.Top1Accuracy(), optim.Loss()],
+                         batch_size=8)
     ckpt = os.environ.get("BIGDL_TEST_CKPT")
     if ckpt:
         o.set_checkpoint(ckpt, optim.Trigger.every_epoch())
@@ -90,7 +96,10 @@ def main():
         from bigdl_tpu.nn.module import state_dict
 
         params = state_dict(trained, kind="param")
-        np.savez(os.environ["BIGDL_TEST_OUT"],
+        extra = {}
+        if os.environ.get("BIGDL_TEST_SHARDED_VAL"):
+            extra["__score"] = np.asarray(o.state["score"])
+        np.savez(os.environ["BIGDL_TEST_OUT"], **extra,
                  **{k: np.asarray(v) for k, v in params.items()})
     print(f"worker {Engine.process_index()}/{Engine.process_count()} done",
           flush=True)

@@ -154,3 +154,70 @@ def test_layer_exception_wraps_path():
     layer = nn.Linear(3, 2).set_name("clf")
     with pytest.raises(nn.LayerException, match="clf"):
         layer.forward(jnp.ones((2, 4)))  # wrong input size
+
+
+def test_eager_backward_memoized_no_retrace():
+    """Second backward() with same shapes must reuse the compiled vjp
+    (round-1 weak item: O(2x forward) retrace per call)."""
+    import time as _time
+
+    model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4),
+                          nn.LogSoftMax())
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    out = model.forward(x)
+    g = jnp.ones_like(out)
+    model.backward(x, g)
+    cache = model.__dict__["_bwd_cache"]
+    assert len(cache) == 1
+    fn = next(iter(cache.values()))
+    n_traces = fn._cache_size()
+    for _ in range(20):
+        model.zero_grad_parameters()
+        model.backward(x, g)
+    assert fn._cache_size() == n_traces  # NOT retraced
+    # train/evaluate flips the key (cache keeps one live trace)
+    model.evaluate()
+    model.backward(x, g)
+    assert len(cache) == 1 and next(iter(cache.values())) is not fn
+    # shape change reuses the same key; jit handles the new shape
+    x2 = jnp.asarray(np.random.RandomState(1).randn(4, 8), jnp.float32)
+    model.forward(x2)
+    model.backward(x2, jnp.ones((4, 4), jnp.float32))
+    assert len(cache) == 1
+
+
+def test_eager_backward_cache_invalidation_and_serialization():
+    """Hyperparameter edits and buffer updates must not replay stale
+    traces; a used eager model must still serialize (BTPU)."""
+    bn_model = nn.Sequential(nn.Linear(4, 4),
+                             nn.BatchNormalization(4))
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4), jnp.float32)
+    # train once (advances running stats), then eval-mode backward
+    bn_model.forward(x)
+    bn_model.evaluate()
+    g1 = np.asarray(bn_model.backward(x, jnp.ones((8, 4), jnp.float32)))
+    # advance the running stats and backward again in eval mode: the
+    # gradient must REFLECT the new stats (buffers are traced args)
+    bn = bn_model.get(1)
+    bn.running_var = jnp.asarray(bn.running_var) * 9.0
+    g2 = np.asarray(bn_model.backward(x, jnp.ones((8, 4), jnp.float32)))
+    assert not np.allclose(g1, g2), "stale buffer baked into cached trace"
+
+    # dropout p edit invalidates via _hyper_version
+    dmodel = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.0))
+    dmodel.forward(x)
+    dmodel.backward(x, jnp.ones((8, 4), jnp.float32))
+    key0 = next(iter(dmodel.__dict__["_bwd_cache"]))
+    dmodel.get(1).set_p(0.9)
+    dmodel.forward(x)
+    dmodel.backward(x, jnp.ones((8, 4), jnp.float32))
+    assert next(iter(dmodel.__dict__["_bwd_cache"])) != key0
+
+    # serialization after eager use (the _bwd_cache must be skipped)
+    from bigdl_tpu.utils.module_format import dumps, loads
+
+    blob = dumps(bn_model)
+    back = loads(blob)
+    y0 = np.asarray(bn_model.forward(x))
+    np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)), y0,
+                               rtol=1e-5, atol=1e-6)

@@ -136,3 +136,15 @@ def test_engine_single_process_defaults():
     assert Engine.process_index() == 0
     assert Engine.is_coordinator()
     assert len(Engine.local_devices()) == Engine.device_count()
+
+
+def test_two_process_sharded_validation_matches_full(tmp_path):
+    """Validation shards round-robin over processes and merges
+    collectively (optim/DistriValidator.scala:35 re-scope): the cluster's
+    merged score must equal the single process evaluating the FULL set,
+    and the trained weights must stay equivalent."""
+    mp = _run_cluster(tmp_path, "mp_val", BIGDL_TEST_SHARDED_VAL=1)
+    sp = _run_single(tmp_path, "sp_val", BIGDL_TEST_SHARDED_VAL=1)
+    a, b = np.load(mp), np.load(sp)
+    np.testing.assert_allclose(a["__score"], b["__score"], rtol=1e-6)
+    _assert_same_params(mp, sp)

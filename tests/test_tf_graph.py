@@ -170,3 +170,140 @@ def test_export_import_cnn_roundtrip():
     np.testing.assert_allclose(np.asarray(re.forward(x)),
                                np.asarray(model.forward(x)),
                                rtol=1e-4, atol=1e-5)
+
+
+# -------- new-op catalog closure, validated against REAL TensorFlow -------
+
+def _tf_golden(build_fn, feeds, outputs):
+    """Build a graph with real TF (v1 mode), return (graphdef_bytes,
+    {output: value})."""
+    import tensorflow as tf
+
+    g = tf.Graph()
+    with g.as_default():
+        build_fn(tf.compat.v1)
+    with tf.compat.v1.Session(graph=g) as sess:
+        vals = sess.run(outputs, feeds)
+    return g.as_graph_def().SerializeToString(), dict(zip(outputs, vals))
+
+
+def test_import_split_pack_transpose_vs_tf():
+    x_np = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+
+    def build(v1):
+        x = v1.placeholder(np.float32, (4, 6), name="x")
+        a, b, c = v1.split(x, 3, axis=1, name="split")
+        s = v1.transpose(a + c, [1, 0], name="tr")
+        v1.stack([s, v1.transpose(b, [1, 0])], axis=0, name="out")
+
+    gd, golden = _tf_golden(build, {"x:0": x_np}, ["out:0"])
+    model = load_graphdef(gd, ["x"], ["out"])
+    got = np.asarray(model.forward(jnp.asarray(x_np)))
+    np.testing.assert_allclose(got, golden["out:0"], rtol=1e-5, atol=1e-6)
+
+
+def test_import_unpack_onehot_slice_vs_tf():
+    idx_np = np.array([[0, 2, 1], [2, 1, 0]], np.int32)
+
+    def build(v1):
+        i = v1.placeholder(np.int32, (2, 3), name="i")
+        rows = v1.unstack(i, axis=0, name="unpack")
+        oh = v1.one_hot(rows[1], 3, on_value=2.0, off_value=-1.0,
+                        name="onehot")
+        v1.slice(oh, [0, 1], [2, 2], name="out")
+
+    gd, golden = _tf_golden(build, {"i:0": idx_np}, ["out:0"])
+    model = load_graphdef(gd, ["i"], ["out"])
+    got = np.asarray(model.forward(jnp.asarray(idx_np)))
+    np.testing.assert_allclose(got, golden["out:0"], rtol=1e-5)
+
+
+def test_import_strided_slice_vs_tf():
+    x_np = np.random.RandomState(1).randn(4, 5, 6).astype(np.float32)
+
+    def build(v1):
+        x = v1.placeholder(np.float32, (4, 5, 6), name="x")
+        a = x[1:3, ::2, 4]           # shrink on last axis
+        v1.identity(a, name="out")
+
+    gd, golden = _tf_golden(build, {"x:0": x_np}, ["out:0"])
+    model = load_graphdef(gd, ["x"], ["out"])
+    got = np.asarray(model.forward(jnp.asarray(x_np)))
+    np.testing.assert_allclose(got, golden["out:0"], rtol=1e-5)
+
+
+def test_import_resize_bilinear_vs_tf():
+    x_np = np.random.RandomState(2).rand(1, 4, 4, 3).astype(np.float32)
+
+    def build(v1):
+        x = v1.placeholder(np.float32, (1, 4, 4, 3), name="x")
+        v1.image.resize_bilinear(x, [8, 8], name="out")
+
+    gd, golden = _tf_golden(build, {"x:0": x_np}, ["out:0"])
+    model = load_graphdef(gd, ["x"], ["out"])
+    got = np.asarray(model.forward(jnp.asarray(x_np)))
+    np.testing.assert_allclose(got, golden["out:0"], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("padding,stride", [("SAME", 2), ("VALID", 2),
+                                            ("SAME", 1)])
+def test_import_conv2d_backprop_input_vs_tf(padding, stride):
+    w_np = np.random.RandomState(3).randn(3, 3, 2, 5).astype(np.float32)
+    out_h = 8
+    in_h = (out_h // stride) if padding == "SAME" \
+        else (out_h - 3) // stride + 1
+    y_np = np.random.RandomState(4).randn(1, in_h, in_h, 5).astype(
+        np.float32)
+
+    def build(v1):
+        y = v1.placeholder(np.float32, y_np.shape, name="y")
+        w = v1.constant(w_np, name="w")
+        v1.nn.conv2d_backprop_input(
+            [1, out_h, out_h, 2], w, y, [1, stride, stride, 1], padding,
+            name="out")
+
+    gd, golden = _tf_golden(build, {"y:0": y_np}, ["out:0"])
+    model = load_graphdef(gd, ["y"], ["out"])
+    got = np.asarray(model.forward(jnp.asarray(y_np)))
+    np.testing.assert_allclose(got, golden["out:0"], rtol=1e-4, atol=1e-4)
+
+
+def test_import_decode_image():
+    import io
+
+    from PIL import Image
+
+    from bigdl_tpu.utils.tf_graph import TensorflowLoader
+
+    img = (np.random.RandomState(5).rand(6, 7, 3) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+
+    def build(v1):
+        s = v1.placeholder(v1.string, (), name="s")
+        v1.image.decode_png(s, channels=3, name="out")
+
+    gd, _ = _tf_golden(build, {"s:0": buf.getvalue()}, [])
+    model = load_graphdef(gd, ["s"], ["out"])
+    got = np.asarray(model.forward(buf.getvalue()))
+    np.testing.assert_array_equal(got, img)
+
+
+def test_import_resize_bilinear_half_pixel_vs_tf():
+    """TF2-style ResizeBilinear (half_pixel_centers=true) must import
+    with the matching grid, not the legacy asymmetric one."""
+    x_np = np.random.RandomState(3).rand(1, 5, 5, 2).astype(np.float32)
+
+    def build(v1):
+        import tensorflow as tf
+
+        x = v1.placeholder(np.float32, (1, 5, 5, 2), name="x")
+        out = tf.raw_ops.ResizeBilinear(images=x, size=[9, 7],
+                                        align_corners=False,
+                                        half_pixel_centers=True)
+        v1.identity(out, name="out")
+
+    gd, golden = _tf_golden(build, {"x:0": x_np}, ["out:0"])
+    model = load_graphdef(gd, ["x"], ["out"])
+    got = np.asarray(model.forward(jnp.asarray(x_np)))
+    np.testing.assert_allclose(got, golden["out:0"], rtol=1e-4, atol=1e-5)

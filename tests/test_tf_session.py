@@ -95,3 +95,82 @@ def test_train_imported_graph_reaches_loss_target(pipeline_graphdef):
     assert loss < 0.75, f"trained loss {loss} did not reach target"
     acc = (logprob.argmax(1) == y).mean()
     assert acc > 0.7, f"trained accuracy {acc} too low"
+
+
+@pytest.fixture(scope="module")
+def image_pipeline_graphdef(tmp_path_factory):
+    """An IMAGE pipeline (Session.scala:173-263): PNG bytes feature ->
+    DecodePng -> Cast -> normalize -> Reshape, behind the same queue
+    machinery.  Class = dominant color channel."""
+    import io
+
+    from PIL import Image
+
+    tmp = tmp_path_factory.mktemp("tfimg")
+    rec_path = str(tmp / "imgs.tfrecord")
+    rng = np.random.RandomState(1)
+    with tf.io.TFRecordWriter(rec_path) as w:
+        for _ in range(48):
+            y = int(rng.randint(0, 3))
+            img = rng.randint(0, 100, (4, 4, 3)).astype(np.uint8)
+            img[:, :, y] += 150
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="PNG")
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "image": tf.train.Feature(bytes_list=tf.train.BytesList(
+                    value=[buf.getvalue()])),
+                "label": tf.train.Feature(int64_list=tf.train.Int64List(
+                    value=[y]))}))
+            w.write(ex.SerializeToString())
+
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+    g = tf1.Graph()
+    with g.as_default():
+        fq = tf1.train.string_input_producer([rec_path], shuffle=False)
+        reader = tf1.TFRecordReader()
+        _, serialized = reader.read(fq)
+        feats = tf1.parse_single_example(serialized, features={
+            "image": tf1.FixedLenFeature([], tf.string),
+            "label": tf1.FixedLenFeature([], tf.int64)})
+        img = tf1.image.decode_png(feats["image"], channels=3)
+        img = tf1.cast(img, tf.float32) / 255.0
+        img = tf1.reshape(img, [48])
+        bx, _by = tf1.train.batch([img, feats["label"]], batch_size=8)
+        w1 = tf1.constant((np.random.RandomState(2).randn(48, 3) * 0.1)
+                          .astype(np.float32), name="W")
+        logits = tf1.matmul(bx, w1, name="logits")
+        tf1.nn.log_softmax(logits, name="logprob")
+    return g.as_graph_def().SerializeToString(), rec_path
+
+
+def test_image_pipeline_records_decoded(image_pipeline_graphdef):
+    gd, _ = image_pipeline_graphdef
+    sess = TFTrainingSession(gd)
+    model, records, graph_ports, label_ports = sess.build(["logprob"])
+    assert len(records) == 48
+    x0, y0 = records[0]
+    assert x0.shape == (48,) and x0.dtype == np.float32
+    assert 0.0 <= float(x0.min()) and float(x0.max()) <= 1.0
+    assert y0.dtype == np.int64
+
+
+def test_image_pipeline_trains(image_pipeline_graphdef):
+    gd, _ = image_pipeline_graphdef
+    sess = TFTrainingSession(gd)
+    trained = sess.train(
+        ["logprob"], criterion=nn.ClassNLLCriterion(),
+        optim_method=optim.SGD(learning_rate=0.5),
+        batch_size=16, end_trigger=optim.Trigger.max_epoch(8))
+    # fresh images of the same rule: dominant channel = class
+    rng = np.random.RandomState(9)
+    xs, ys = [], []
+    for _ in range(32):
+        y = int(rng.randint(0, 3))
+        img = rng.randint(0, 100, (4, 4, 3)).astype(np.uint8)
+        img[:, :, y] += 150
+        xs.append(img.reshape(48).astype(np.float32) / 255.0)
+        ys.append(y)
+    logprob = np.asarray(trained.evaluate().forward(np.stack(xs)))
+    acc = (logprob.argmax(1) == np.asarray(ys)).mean()
+    assert acc > 0.8, f"trained accuracy {acc} too low"
