@@ -1,0 +1,150 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh
+axis.
+
+The reference's nearest relative is the local gating container
+``MixtureTable`` (``nn/MixtureTable.scala``): gate weights blend expert
+outputs on one machine.  This layer is the scaled TPU-first design: a
+learned router dispatches tokens to E feed-forward experts whose stacked
+parameters shard over the ``expert`` axis — the Mesh-TensorFlow /
+GShard-style DENSE dispatch (one-hot capacity-bucketed einsums) that XLA
+lowers to all-to-all collectives when tokens are data-sharded and experts
+expert-sharded.  No sparse scatter: static shapes keep the MXU fed.
+
+Routing: top-k gating with a per-expert capacity
+``C = ceil(top_k * tokens / E * capacity_factor)``; tokens over capacity
+are dropped (their combine weight is zero), the standard GShard policy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module, Parameter
+from bigdl_tpu.nn.init import Xavier
+from bigdl_tpu.utils.rng import next_rng_id, require_rng
+
+__all__ = ["MixtureOfExperts", "expert_sharding_rules"]
+
+
+def expert_sharding_rules(axis: str = "expert"):
+    """``extra_sharding_rules`` hook for TrainStep: shards every
+    parameter whose path contains ``experts`` on its leading (expert)
+    dimension."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(path: str, arr):
+        if "expert" in path and getattr(arr, "ndim", 0) >= 1:
+            return P(axis, *([None] * (arr.ndim - 1)))
+        return None
+
+    return rule
+
+
+class MixtureOfExperts(Module):
+    """Token-routed MoE FFN block.
+
+    Input [tokens, d_model] (or [batch, seq, d_model], flattened for
+    routing); output the same shape.  Experts are two-layer FFNs with
+    stacked parameters ``experts_w1 [E, D, H]`` etc.; under a mesh with
+    an ``expert`` axis, pass ``expert_sharding_rules()`` to TrainStep so
+    the stacks shard and dispatch/combine einsums become all-to-alls."""
+
+    def __init__(self, d_model: int, d_hidden: int, n_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 noise_std: float = 0.0):
+        super().__init__()
+        self.d_model, self.d_hidden, self.n_experts = \
+            d_model, d_hidden, n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.noise_std = noise_std
+        self._rng_id = next_rng_id()
+        init = Xavier
+        self.gate_weight = Parameter(
+            init.init((d_model, n_experts), fan_in=d_model,
+                      fan_out=n_experts))
+        self.experts_w1 = Parameter(init.init(
+            (n_experts, d_model, d_hidden), fan_in=d_model,
+            fan_out=d_hidden))
+        self.experts_b1 = Parameter(
+            jnp.zeros((n_experts, d_hidden), jnp.float32))
+        self.experts_w2 = Parameter(init.init(
+            (n_experts, d_hidden, d_model), fan_in=d_hidden,
+            fan_out=d_model))
+        self.experts_b2 = Parameter(
+            jnp.zeros((n_experts, d_model), jnp.float32))
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(math.ceil(
+            self.top_k * n_tokens / self.n_experts * self.capacity_factor)))
+
+    def _route(self, x):
+        """x [T, D] -> (dispatch [T, E, C] one-hot, combine [T, E, C])."""
+        t = x.shape[0]
+        e = self.n_experts
+        c = self.capacity(t)
+        logits = x @ self.gate_weight.astype(x.dtype)
+        if self.training and self.noise_std > 0.0:
+            # noisy top-k gating: exploration noise on the router logits
+            key = require_rng(self._rng_id)
+            logits = logits + self.noise_std * jax.random.normal(
+                key, logits.shape, logits.dtype)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        # top-k per token, processed one choice at a time so capacity
+        # counters accumulate across choices (GShard's sequential greedy)
+        _, topk_idx = jax.lax.top_k(gates, self.top_k)
+        dispatch = jnp.zeros((t, e, c), jnp.float32)
+        combine = jnp.zeros((t, e, c), jnp.float32)
+        counts = jnp.zeros((e,), jnp.int32)
+        for k in range(self.top_k):
+            idx = topk_idx[:, k]                     # [T]
+            onehot = jax.nn.one_hot(idx, e)          # [T, E]
+            # position of each token within its expert's bucket:
+            # running count over the token dim, offset by prior choices
+            pos_in_e = (jnp.cumsum(onehot, axis=0) - 1.0) \
+                + counts[None, :].astype(jnp.float32)
+            pos = jnp.sum(pos_in_e * onehot, axis=1).astype(jnp.int32)
+            keep = pos < c                            # capacity drop
+            pos_oh = jax.nn.one_hot(jnp.where(keep, pos, 0), c)
+            slot = onehot[:, :, None] * pos_oh[:, None, :] \
+                * keep[:, None, None]
+            dispatch = dispatch + slot
+            gate_k = jnp.sum(gates * onehot, axis=1)
+            combine = combine + slot * gate_k[:, None, None]
+            counts = counts + jnp.sum(
+                onehot * keep[:, None], axis=0).astype(jnp.int32)
+        return dispatch, combine
+
+    def update_output(self, input):
+        x = input
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, self.d_model)
+        dispatch, combine = self._route(x2)
+        xd = x2.astype(jnp.float32)
+        # [T,E,C],[T,D] -> [E,C,D]: the all-to-all dispatch einsum
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, xd)
+        h = jnp.einsum("ecd,edh->ech", expert_in,
+                       self.experts_w1.astype(jnp.float32))
+        h = jax.nn.relu(h + self.experts_b1[:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h,
+                         self.experts_w2.astype(jnp.float32))
+        out = out + self.experts_b2[:, None, :]
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        return y.reshape(lead + (self.d_model,)).astype(x.dtype)
+
+    def aux_load_balancing_loss(self, input) -> jax.Array:
+        """GShard/Switch auxiliary loss: E * dot(mean gate fraction,
+        mean dispatch fraction) — add to the criterion to keep experts
+        balanced."""
+        x2 = input.reshape(-1, self.d_model)
+        logits = x2 @ self.gate_weight.astype(x2.dtype)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top1 = jnp.argmax(gates, axis=-1)
+        frac_tokens = jnp.mean(jax.nn.one_hot(top1, self.n_experts), axis=0)
+        frac_gates = jnp.mean(gates, axis=0)
+        return self.n_experts * jnp.sum(frac_tokens * frac_gates)

@@ -201,9 +201,12 @@ class Module:
         t0 = time.perf_counter()
         params = state_dict(self, kind="param")
         # Replay the key forward() used so the vjp recomputation sees the
-        # same random realization the user observed.
-        replay_key = None
-        if current_rng_key() is None:
+        # same random realization the user observed.  An AMBIENT context
+        # key must also ride as the traced argument — otherwise the
+        # cached jit would bake the first call's key in as a constant and
+        # replay stale dropout masks on every later step.
+        replay_key = current_rng_key()
+        if replay_key is None:
             replay_key = self.__dict__.get("_last_rng_key")
 
         # functional_call clears trace scratch (_last_rng_key, Recurrent

@@ -277,6 +277,8 @@ class Optimizer:
 
             results = merge_across_processes(results, self._val_methods)
             count = int(results[0].result()[1]) if results else count
+            if count == 0:
+                results = None  # no process saw a batch: nothing measured
         if results is None:
             return
         wall = time.perf_counter() - t0

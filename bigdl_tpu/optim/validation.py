@@ -43,8 +43,12 @@ def merge_across_processes(results, methods):
     else:
         state = np.asarray([r._state() for r in results], np.float64)
         kinds = [type(r) for r in results]
-    gathered = multihost_utils.process_allgather(state)
-    totals = gathered.reshape(-1, *state.shape).sum(axis=0)
+    # gather the float64 BYTES as uint32 words: process_allgather would
+    # otherwise downcast to float32 (x64 disabled), corrupting counts
+    # beyond 2^24
+    words = np.ascontiguousarray(state).view(np.uint32)
+    gathered = np.asarray(multihost_utils.process_allgather(words))
+    totals = gathered.reshape(-1, *words.shape).view(np.float64).sum(axis=0)
     return [cls(a, b) for cls, (a, b) in zip(kinds, totals)]
 
 

@@ -1,13 +1,17 @@
 """Device-mesh utilities (the TPU-native replacement for the reference's
 Engine node/core topology, ``utils/Engine.scala:313-418``).
 
-Axes convention:
-- ``data``  — data parallelism (the reference's only axis)
-- ``model`` — tensor parallelism (new capability, TPU-first)
-- ``seq``   — sequence/context parallelism for long sequences (ring
-  attention / all-to-all; new capability)
-- ``pipe``  — pipeline stages
+Axes convention (each axis has working machinery behind it):
+- ``data``  — data parallelism (the reference's only axis;
+  ``parallel/train_step.py`` batch sharding + ZeRO-1)
+- ``model`` — tensor parallelism (``TrainStep.extra_sharding_rules``
+  megatron-style weight shardings; see ``__graft_entry__.dryrun_multichip``)
+- ``seq``   — sequence/context parallelism for long sequences
+  (``parallel/sequence.py`` ring attention / Ulysses all-to-all)
+- ``pipe``  — pipeline stages (``parallel/pipeline.py`` GPipe/ppermute
+  schedule)
 - ``expert``— expert parallelism for MoE layers
+  (``nn/layers/moe.py`` GShard-style dense dispatch)
 """
 
 from __future__ import annotations

@@ -21,7 +21,8 @@ dependency).
 Wire subset decoded: GraphDef.node(1); NodeDef name(1)/op(2)/input(3)/
 attr(5, map<string, AttrValue>); AttrValue list(1)/s(2)/i(3)/f(4)/b(5)/
 type(6)/shape(7)/tensor(8); TensorProto dtype(1)/shape(2)/content(4)/
-float_val(5)/int_val(6)/int64_val(10); TensorShapeProto.dim(2).size(1).
+float_val(5)/double_val(6)/int_val(7)/string_val(8)/int64_val(10)/
+bool_val(11); TensorShapeProto.dim(2).size(1).
 """
 
 from __future__ import annotations

@@ -232,19 +232,10 @@ class TFTrainingSession:
         a = node["attrs"]
         ins = [i for i in node["inputs"] if not i.startswith("^")]
         if op in ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp"):
-            channels = int(a.get("channels", 3) or 3)
-            mode = {1: "L", 3: "RGB", 4: "RGBA"}[channels]
+            from bigdl_tpu.nn import ops as nnops
 
-            def decode(value):
-                import io
-
-                from PIL import Image
-
-                arr = np.asarray(Image.open(io.BytesIO(bytes(value)))
-                                 .convert(mode))
-                return arr if arr.ndim == 3 else arr[:, :, None]
-
-            return decode
+            dec = nnops.DecodeImage(int(a.get("channels", 3) or 3))
+            return lambda value: np.asarray(dec.update_output(value))
         if op == "DecodeRaw":
             dt = a.get("out_type")
             dt = _TF_DTYPES.get(dt[1] if isinstance(dt, tuple) else dt,

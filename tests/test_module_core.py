@@ -221,3 +221,20 @@ def test_eager_backward_cache_invalidation_and_serialization():
     y0 = np.asarray(bn_model.forward(x))
     np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)), y0,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_eager_backward_fresh_ambient_rng_key():
+    """A per-step rng_context key must flow into the memoized backward as
+    a traced argument — never baked into the cached trace."""
+    from bigdl_tpu.utils.rng import rng_context
+
+    model = nn.Sequential(nn.Linear(6, 6), nn.Dropout(0.5))
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 6), jnp.float32)
+    grads = []
+    for step in range(2):
+        with rng_context(jax.random.key(step)):
+            model.forward(x)
+            g = model.backward(x, jnp.ones((16, 6), jnp.float32))
+        grads.append(np.asarray(g))
+    assert len(model.__dict__["_bwd_cache"]) == 1  # cache reused...
+    assert not np.allclose(grads[0], grads[1])     # ...but keys differ
