@@ -168,9 +168,9 @@ def cmd_train(args) -> None:
         val_samples = samples[:256]
     elif args.model == "transformer":
         samples = [Sample(x[i], y[i]) for i in range(len(x))]
-        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True)
         val_methods = [optim.Loss(
-            nn.TimeDistributedCriterion(nn.ClassNLLCriterion()))]
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True))]
         val_samples = [Sample(xt[i], yt[i]) for i in range(len(xt))]
     else:
         samples = [Sample(x[i], y[i]) for i in range(len(x))]
@@ -230,7 +230,7 @@ def cmd_test(args) -> None:
     samples = [Sample(x[i], y[i]) for i in range(len(x))]
     if args.model == "transformer":
         methods = [optim.Loss(
-            nn.TimeDistributedCriterion(nn.ClassNLLCriterion()))]
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True))]
     else:
         methods = [optim.Top1Accuracy(), optim.Top5Accuracy()]
     res = optim.Evaluator(model, batch_size=args.batch_size).evaluate(
@@ -265,7 +265,7 @@ def cmd_perf(args) -> None:
             x = rng.integers(0, num_classes,
                              (args.batch_size, LM_SEQ_LEN), dtype=np.int32)
             y = rng.integers(0, num_classes, (args.batch_size, LM_SEQ_LEN))
-            criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+            criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True)
         x, y = jnp.asarray(x), jnp.asarray(y)
     else:
         shape = {"lenet": (1, 28, 28), "autoencoder": (1, 28, 28)}.get(

@@ -9,7 +9,9 @@ sequence parallelism over a mesh ``seq`` axis.
 ``build_transformer_lm`` returns a causal decoder LM:
 token embedding + learned positions -> N pre-norm TransformerBlocks ->
 final LayerNorm -> vocab head (log-probs per position, so
-``TimeDistributedCriterion(ClassNLLCriterion())`` trains it).
+``TimeDistributedCriterion(ClassNLLCriterion(), size_average=True)``
+trains it — size_average averages the per-step losses; the default sums
+them, scaling the loss by sequence length).
 
 ``sp_mesh``/``sp_axis``/``sp_strategy`` route every block's attention
 through shard_map'd ring or Ulysses attention for sequences larger than

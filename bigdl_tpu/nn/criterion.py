@@ -477,7 +477,8 @@ class DiceCoefficientCriterion(Criterion):
         t = t.reshape((x.shape[0], -1))
         inter = jnp.sum(x * t, axis=1)
         union = jnp.sum(x, axis=1) + jnp.sum(t, axis=1)
-        dice = 1.0 - 2.0 * inter / (union + self.epsilon)
+        # epsilon offsets BOTH terms (DiceCoefficientCriterion.scala:69-81)
+        dice = 1.0 - (2.0 * inter + self.epsilon) / (union + self.epsilon)
         return _reduce(dice, self.size_average)
 
 
@@ -491,11 +492,19 @@ class TimeDistributedCriterion(Criterion):
         self.size_average = size_average
 
     def update_output(self, input, target):
-        b, t = input.shape[0], input.shape[1]
-        x = input.reshape((b * t,) + input.shape[2:])
-        tt = jnp.asarray(target).reshape((b * t,) + jnp.asarray(target).shape[2:])
-        loss = self.criterion.update_output(x, tt)
-        return loss / t if self.size_average else loss
+        # reference semantics: the inner criterion runs PER TIMESTEP and
+        # the step losses are summed (averaged when size_average).
+        # vmap over the time axis keeps that exact for ANY inner
+        # criterion — including weighted ones whose per-step
+        # normalization differs from a flattened [B*T] pass — without
+        # unrolling the sequence.
+        t = input.shape[1]
+        import jax
+
+        losses = jax.vmap(self.criterion.update_output, in_axes=(1, 1))(
+            input, jnp.asarray(target))
+        total = jnp.sum(losses)
+        return total / t if self.size_average else total
 
 
 class SoftmaxWithCriterion(Criterion):

@@ -119,11 +119,13 @@ class Module:
         if "_modules" in d and name in d["_modules"] and not isinstance(value, Module):
             del d["_modules"][name]
         # plain-attribute (hyperparameter) edits invalidate memoized
-        # backward traces — the value may be baked into a cached jit
+        # backward traces — the value may be baked into a cached jit.
+        # Only SCALAR equality short-circuits the bump (container values
+        # may hold arrays whose == is elementwise).
         old = d.get(name, _UNSET)
-        if old is not value and not (
-                isinstance(value, (int, float, str, bool, tuple, type(None)))
-                and isinstance(old, type(value)) and old == value):
+        if not (old is value or (
+                isinstance(value, (int, float, str, bool, type(None)))
+                and isinstance(old, type(value)) and old == value)):
             d["_hyper_version"] = d.get("_hyper_version", 0) + 1
         d[name] = value
 

@@ -142,7 +142,7 @@ def test_transformer_lm_trains_with_sequence_parallel_mesh():
     lm = models.build_transformer_lm(vocab_size=32, num_layers=1,
                                      embed_dim=16, num_heads=2, max_len=32,
                                      sp_mesh=mesh)
-    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True)
     step = TrainStep(lm, crit, optim.SGD(learning_rate=0.5))
     rng = np.random.RandomState(1)
     tokens = rng.randint(0, 32, (4, 32))
@@ -171,3 +171,36 @@ def test_cli_token_data_shapes():
     assert x.ndim == 2 and x.dtype.kind == "i" and len(x) == len(y)
     xt, yt = cli._load_data("transformer", None, "test")
     assert xt.shape == yt.shape and xt.shape[1] == cli.LM_SEQ_LEN
+
+
+def test_textclassification_example_learns():
+    """example/textclassification parity (TextClassifier.scala conv
+    stack): the synthetic 5-topic corpus must be learnable."""
+    import examples.textclassification as tc
+
+    _, _, _, acc = tc.main(["--max-epoch", "4", "--seq-len", "150",
+                            "--synthetic-size", "250", "--batch-size", "16",
+                            "--learning-rate", "0.05"])
+    assert acc >= 0.7, acc
+
+
+def test_udfpredictor_example_udf_and_query():
+    """example/udfpredictor parity: the predict-UDF query flow (a quick
+    1-epoch model — the full training quality is covered by the
+    textclassification test above)."""
+    import examples.textclassification as tc
+    import examples.udfpredictor as up
+
+    model, word_index, table, _ = tc.main(
+        ["--max-epoch", "1", "--seq-len", "150",
+         "--synthetic-size", "100", "--batch-size", "16"])
+    udf = up.make_predict_udf(model, word_index, table, 150)
+    rows = [{"id": i, "text": "rocket orbit nasa launch"} for i in range(3)]
+    preds = udf([r["text"] for r in rows])
+    assert preds.shape == (3,)
+    kept, preds2 = up.query(rows, "text", udf, {int(preds[0])})
+    assert len(kept) == 3  # identical texts -> identical class
+    assert all(r["predicted"] == int(preds[0]) for r in kept)
+    kept_none, _ = up.query(rows, "text", udf,
+                            {int(preds[0]) + 1000})
+    assert kept_none == []
