@@ -74,6 +74,14 @@ def _configs():
         "lstm_text": (
             lambda: models.build_lstm_classifier(5000, class_num=20),
             lambda b: tokens(b, 200, 5000, 20), nn.ClassNLLCriterion(), 256),
+        # representative large recurrent shape: the tiny config above is
+        # latency-bound (see BASELINE.md roofline note); this one feeds
+        # the MXU a 1536x4096 fused-gate matmul per scan step
+        "lstm_text_large": (
+            lambda: models.build_lstm_classifier(
+                20000, embed_dim=512, hidden_size=1024, num_layers=2,
+                class_num=20),
+            lambda b: tokens(b, 200, 20000, 20), nn.ClassNLLCriterion(), 512),
         "resnet50_imagenet": (
             lambda: models.build_resnet(50, 1000),
             lambda b: img(b, 3, 224, 224, 1000), nn.ClassNLLCriterion(), 128),

@@ -22,12 +22,18 @@ def build_simple_rnn(input_size: int = 4000, hidden_size: int = 40,
 
 def build_lstm_classifier(vocab_size: int, embed_dim: int = 128,
                           hidden_size: int = 128, class_num: int = 2,
+                          num_layers: int = 1,
                           one_based_tokens: bool = False) -> nn.Module:
-    """LSTM text classification: embedding -> LSTM -> last step -> dense."""
-    return nn.Sequential(
-        nn.LookupTable(vocab_size, embed_dim, one_based=one_based_tokens),
-        nn.Recurrent(nn.LSTM(embed_dim, hidden_size)),
-        nn.Select(1, -1),
-        nn.Linear(hidden_size, class_num),
-        nn.LogSoftMax(),
-    )
+    """LSTM text classification: embedding -> LSTM stack -> last step ->
+    dense.  ``num_layers`` stacks LSTMs (each a scan with the fused-gate
+    matmul) — the representative large-model shape for the perf harness."""
+    m = nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim, one_based=one_based_tokens))
+    in_dim = embed_dim
+    for _ in range(num_layers):
+        m.add(nn.Recurrent(nn.LSTM(in_dim, hidden_size)))
+        in_dim = hidden_size
+    m.add(nn.Select(1, -1))
+    m.add(nn.Linear(hidden_size, class_num))
+    m.add(nn.LogSoftMax())
+    return m

@@ -499,8 +499,6 @@ class TimeDistributedCriterion(Criterion):
         # normalization differs from a flattened [B*T] pass — without
         # unrolling the sequence.
         t = input.shape[1]
-        import jax
-
         losses = jax.vmap(self.criterion.update_output, in_axes=(1, 1))(
             input, jnp.asarray(target))
         total = jnp.sum(losses)

@@ -120,6 +120,10 @@ def main(argv=None):
 
     samples = [Sample(vectorize(t, word_index, table, args.seq_len),
                       np.int64(l)) for t, l in pairs]
+    # real 20-newsgroups data arrives grouped by class directory — a
+    # seeded shuffle keeps every class on both sides of the split
+    order = np.random.default_rng(7).permutation(len(samples))
+    samples = [samples[i] for i in order]
     split = int(0.8 * len(samples))
     train, val = samples[:split], samples[split:]
 
