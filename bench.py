@@ -108,7 +108,9 @@ def run_config(name, build_model, build_batch, criterion, batch, iters):
     from bigdl_tpu.utils.rng import RNG
 
     RNG.set_seed(0)
-    model = build_model()
+    from bigdl_tpu.nn.fuse import optimize_for_tpu
+
+    model = optimize_for_tpu(build_model())
     step = TrainStep(model, criterion,
                      optim.SGD(learning_rate=0.01, momentum=0.9),
                      compute_dtype=jnp.bfloat16)

@@ -14,35 +14,37 @@ import bigdl_tpu.nn as nn
 __all__ = ["inception_layer_v1", "build_inception_v1", "build_inception_v2"]
 
 
-def inception_layer_v1(input_size: int, config, name_prefix: str = "") -> nn.Module:
+def inception_layer_v1(input_size: int, config, name_prefix: str = "",
+                       format: str = "NCHW") -> nn.Module:
     """One inception module: 1x1 / 3x3reduce+3x3 / 5x5reduce+5x5 / pool+proj
     branches concatenated on the channel dim (``Inception_v1.scala``
     ``inception`` fn)."""
-    concat = nn.Concat(1).set_name(name_prefix + "inception")
+    c_dim = 3 if format == "NHWC" else 1
+    concat = nn.Concat(c_dim).set_name(name_prefix + "inception")
     conv1 = nn.Sequential(
-        nn.SpatialConvolution(input_size, config[0][0], 1, 1, 1, 1)
+        nn.SpatialConvolution(input_size, config[0][0], 1, 1, 1, 1, format=format)
         .set_name(name_prefix + "1x1"),
         nn.ReLU(True))
     concat.add(conv1)
     conv3 = nn.Sequential(
-        nn.SpatialConvolution(input_size, config[1][0], 1, 1, 1, 1)
+        nn.SpatialConvolution(input_size, config[1][0], 1, 1, 1, 1, format=format)
         .set_name(name_prefix + "3x3_reduce"),
         nn.ReLU(True),
-        nn.SpatialConvolution(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1)
+        nn.SpatialConvolution(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1, format=format)
         .set_name(name_prefix + "3x3"),
         nn.ReLU(True))
     concat.add(conv3)
     conv5 = nn.Sequential(
-        nn.SpatialConvolution(input_size, config[2][0], 1, 1, 1, 1)
+        nn.SpatialConvolution(input_size, config[2][0], 1, 1, 1, 1, format=format)
         .set_name(name_prefix + "5x5_reduce"),
         nn.ReLU(True),
-        nn.SpatialConvolution(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2)
+        nn.SpatialConvolution(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2, format=format)
         .set_name(name_prefix + "5x5"),
         nn.ReLU(True))
     concat.add(conv5)
     pool = nn.Sequential(
-        nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
-        nn.SpatialConvolution(input_size, config[3][0], 1, 1, 1, 1)
+        nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1, format=format).ceil(),
+        nn.SpatialConvolution(input_size, config[3][0], 1, 1, 1, 1, format=format)
         .set_name(name_prefix + "pool_proj"),
         nn.ReLU(True))
     concat.add(pool)
@@ -50,38 +52,40 @@ def inception_layer_v1(input_size: int, config, name_prefix: str = "") -> nn.Mod
 
 
 def build_inception_v1(class_num: int = 1000, has_dropout: bool = True,
-                       with_aux: bool = False) -> nn.Module:
+                       with_aux: bool = False, format: str = "NCHW") -> nn.Module:
     """GoogLeNet (``Inception_v1.scala`` inception_v1_NoAuxClassifier /
-    inception_v1)."""
+    inception_v1).  ``format="NHWC"`` builds the channels-last variant
+    (TPU's native conv layout; same parameters, transposed activations)."""
+    f = format
     feature1 = nn.Sequential(
-        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3).set_name("conv1/7x7_s2"),
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, format=f).set_name("conv1/7x7_s2"),
         nn.ReLU(True),
-        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"),
-        nn.SpatialConvolution(64, 64, 1, 1, 1, 1).set_name("conv2/3x3_reduce"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, format=f).ceil(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75, format=f).set_name("pool1/norm1"),
+        nn.SpatialConvolution(64, 64, 1, 1, 1, 1, format=f).set_name("conv2/3x3_reduce"),
         nn.ReLU(True),
-        nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1).set_name("conv2/3x3"),
+        nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1, format=f).set_name("conv2/3x3"),
         nn.ReLU(True),
-        nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"),
-        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"),
-        inception_layer_v1(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"),
-        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        inception_layer_v1(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75, format=f).set_name("conv2/norm2"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, format=f).ceil(),
+        inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/", f),
+        inception_layer_v1(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/", f),
+        nn.SpatialMaxPooling(3, 3, 2, 2, format=f).ceil(),
+        inception_layer_v1(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/", f),
     )
     feature2 = nn.Sequential(
-        inception_layer_v1(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"),
-        inception_layer_v1(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"),
-        inception_layer_v1(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"),
+        inception_layer_v1(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/", f),
+        inception_layer_v1(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/", f),
+        inception_layer_v1(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/", f),
     )
     feature3 = nn.Sequential(
-        inception_layer_v1(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"),
-        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        inception_layer_v1(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"),
-        inception_layer_v1(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"),
+        inception_layer_v1(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/", f),
+        nn.SpatialMaxPooling(3, 3, 2, 2, format=f).ceil(),
+        inception_layer_v1(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/", f),
+        inception_layer_v1(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/", f),
     )
     head = nn.Sequential(
-        nn.SpatialAveragePooling(7, 7, 1, 1),
+        nn.SpatialAveragePooling(7, 7, 1, 1, format=f),
         nn.View(1024).set_num_input_dims(3),
     )
     if has_dropout:
@@ -94,8 +98,9 @@ def build_inception_v1(class_num: int = 1000, has_dropout: bool = True,
 
     def aux_head(in_ch: int, name: str) -> nn.Module:
         return nn.Sequential(
-            nn.SpatialAveragePooling(5, 5, 3, 3).ceil(),
-            nn.SpatialConvolution(in_ch, 128, 1, 1, 1, 1).set_name(name + "/conv"),
+            nn.SpatialAveragePooling(5, 5, 3, 3, format=f).ceil(),
+            nn.SpatialConvolution(in_ch, 128, 1, 1, 1, 1, format=f)
+            .set_name(name + "/conv"),
             nn.ReLU(True),
             nn.View(128 * 4 * 4).set_num_input_dims(3),
             nn.Linear(128 * 4 * 4, 1024).set_name(name + "/fc"),

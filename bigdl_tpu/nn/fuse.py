@@ -1,0 +1,209 @@
+"""Graph-level TPU optimization passes over module trees.
+
+The reference optimizes its execution graph at the Scala level (e.g. the
+``ir`` package's conversions and fusions feeding MKL-DNN,
+``utils/intermediate/IRGraph.scala``); here the hot structural rewrite is
+**sibling-convolution merging**: a ``Concat`` whose branches all start
+with a 1x1/kxk convolution *of the same signature over the same input*
+(the Inception pattern, ``models/inception/Inception_v1.scala``) computes
+several small GEMMs whose output-channel counts (16..128) each pad up to
+the MXU's 128-lane tile.  Merging them into ONE convolution with the
+concatenated output channels runs one well-tiled GEMM instead, and the
+branch remainders read channel slices (``Narrow``) that XLA fuses into
+their consumers.  The rewrite preserves the math and the parameter
+values exactly (only the packing changes); outputs agree with the
+unfused graph up to GEMM-regrouping float reassociation.
+
+Apply via ``optimize_for_tpu(model)`` BEFORE building a train step or
+checkpointing: the merged model's state_dict packs the sibling weights
+into one tensor, so it is not parameter-compatible with the unfused
+layout.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.layers.container_ext import Concat
+from bigdl_tpu.nn.layers.conv import SpatialConvolution
+from bigdl_tpu.nn.layers.normalization import SpatialBatchNormalization
+from bigdl_tpu.nn.layers.shape import Narrow
+from bigdl_tpu.nn.module import Container, Module, Sequential
+
+__all__ = ["optimize_for_tpu", "merge_sibling_convs", "fold_batchnorm"]
+
+
+def optimize_for_tpu(model: Module) -> Module:
+    """Run the training-safe graph passes in place; returns the model for
+    chaining.  (``fold_batchnorm`` is inference-only and therefore NOT
+    included here.)"""
+    return merge_sibling_convs(model)
+
+
+def merge_sibling_convs(model: Module) -> Module:
+    """Merge runs of adjacent ``Concat`` branches that start with
+    same-signature convolutions (see module docstring).  In-place."""
+    _walk(model)
+    return model
+
+
+def _walk(m: Module) -> None:
+    if isinstance(m, Container):
+        for child in m.layers:
+            _walk(child)
+        if isinstance(m, Concat):
+            _merge_concat(m)
+
+
+def _leading_conv(branch: Module) -> Optional[Tuple[SpatialConvolution, List[Module]]]:
+    """(conv, rest-of-branch) when the branch starts with a plain conv."""
+    if type(branch) in (SpatialConvolution,):
+        conv, rest = branch, []
+    elif type(branch) is Sequential and len(branch) > 0 \
+            and type(branch.get(0)) is SpatialConvolution:
+        conv, rest = branch.get(0), branch.layers[1:]
+    else:
+        return None
+    # merging repacks weights: bail out when per-layer training metadata
+    # (freeze/scale/regularizers) would have to be split back apart
+    d = conv.__dict__
+    if conv.n_group != 1 or d.get("_frozen") \
+            or d.get("scale_w", 1.0) != 1.0 or d.get("scale_b", 1.0) != 1.0 \
+            or d.get("w_regularizer") is not None \
+            or d.get("b_regularizer") is not None:
+        return None
+    return conv, rest
+
+
+def _signature(conv: SpatialConvolution):
+    return (conv.n_input_plane, conv.kernel_w, conv.kernel_h,
+            conv.stride_w, conv.stride_h, conv.pad_w, conv.pad_h,
+            conv.with_bias, conv.format, conv.propagate_back)
+
+
+def _merge_run(dim: int, entries) -> Module:
+    """One branch replacing a run of (conv, rest) branches: the merged
+    conv followed by an inner Concat of Narrow-sliced remainders."""
+    convs = [c for c, _ in entries]
+    c0 = convs[0]
+    w = jnp.concatenate([c.weight for c in convs], axis=0)
+    b = jnp.concatenate([c.bias for c in convs], axis=0) \
+        if c0.with_bias else None
+    total = sum(c.n_output_plane for c in convs)
+    merged = SpatialConvolution(
+        c0.n_input_plane, total, c0.kernel_w, c0.kernel_h,
+        c0.stride_w, c0.stride_h, c0.pad_w, c0.pad_h,
+        propagate_back=c0.propagate_back, init_weight=w, init_bias=b,
+        with_bias=c0.with_bias, format=c0.format)
+    merged.set_name("+".join(c.get_name() for c in convs))
+    inner = Concat(dim)
+    offset = 0
+    for conv, rest in entries:
+        inner.add(Sequential(Narrow(dim, offset, conv.n_output_plane), *rest))
+        offset += conv.n_output_plane
+    return Sequential(merged, inner)
+
+
+def _merge_concat(m: Concat) -> None:
+    c_axis = {"NCHW": 1, "NHWC": 3}
+    parsed = []
+    for branch in m.layers:
+        entry = _leading_conv(branch)
+        if entry is not None and c_axis.get(entry[0].format) != m.dim:
+            entry = None  # concat must run along the conv channel axis
+        parsed.append((branch, entry))
+
+    out: List[Module] = []
+    run: List[Tuple[Module, Tuple[SpatialConvolution, List[Module]]]] = []
+
+    def flush():
+        nonlocal run
+        if len(run) >= 2:
+            out.append(_merge_run(m.dim, [e for _, e in run]))
+        else:
+            out.extend(branch for branch, _ in run)
+        run = []
+
+    for branch, entry in parsed:
+        if entry is None:
+            flush()
+            out.append(branch)
+        elif run and _signature(entry[0]) != _signature(run[0][1][0]):
+            flush()
+            run.append((branch, entry))
+        else:
+            run.append((branch, entry))
+    flush()
+
+    if len(out) != len(m.layers):
+        m.__dict__["_modules"].clear()
+        for branch in out:
+            m.add(branch)
+
+
+def fold_batchnorm(model: Module) -> Module:
+    """INFERENCE-ONLY pass: fold each ``SpatialBatchNormalization`` that
+    directly follows a ``SpatialConvolution`` inside a ``Sequential`` into
+    the conv's weights (the standard conv-BN algebra over the RUNNING
+    statistics):
+
+        w' = w * gamma / sqrt(var + eps)      (per output channel)
+        b' = (b - mean) * gamma / sqrt(var + eps) + beta
+
+    After folding, the BN layer disappears — one conv per block at serving
+    time (the inference-graph fusion the reference performs when lowering
+    to its MKL-DNN ``ir`` graph, ``utils/intermediate/IRGraph.scala``).
+    Training a folded model would be WRONG (no batch statistics), so this
+    is never part of :func:`optimize_for_tpu`; call it on a model about to
+    be served/exported.  In place."""
+
+    def walk(m: Module) -> None:
+        if not isinstance(m, Container):
+            return
+        for child in m.layers:
+            walk(child)
+        if type(m) is not Sequential:
+            return
+        mods = m.__dict__["_modules"]
+        layers = list(mods.values())
+        out: List[Module] = []
+        i = 0
+        while i < len(layers):
+            cur, nxt = layers[i], layers[i + 1] if i + 1 < len(layers) else None
+            if type(cur) is SpatialConvolution \
+                    and type(nxt) is SpatialBatchNormalization \
+                    and nxt.affine and cur.n_output_plane == nxt.n_output \
+                    and cur.format == nxt.format:
+                scale = nxt.weight / jnp.sqrt(nxt.running_var + nxt.eps)
+                w = cur.weight * scale.reshape(-1, 1, 1, 1)
+                b0 = cur.bias if cur.with_bias \
+                    else jnp.zeros((cur.n_output_plane,), jnp.float32)
+                b = (b0 - nxt.running_mean) * scale + nxt.bias
+                if cur.with_bias:
+                    cur.weight, cur.bias = w, b
+                    folded = cur
+                else:
+                    # the usual conv(bias=False)+BN pairing: the fold
+                    # materializes the bias, so rebuild the conv with one
+                    folded = SpatialConvolution(
+                        cur.n_input_plane, cur.n_output_plane,
+                        cur.kernel_w, cur.kernel_h, cur.stride_w,
+                        cur.stride_h, cur.pad_w, cur.pad_h,
+                        n_group=cur.n_group,
+                        propagate_back=cur.propagate_back,
+                        init_weight=w, init_bias=b, format=cur.format)
+                    folded.set_name(cur.get_name())
+                out.append(folded)
+                i += 2
+            else:
+                out.append(cur)
+                i += 1
+        if len(out) != len(layers):
+            mods.clear()
+            for l in out:
+                m.add(l)
+
+    walk(model)
+    return model

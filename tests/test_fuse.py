@@ -1,0 +1,178 @@
+"""Graph-optimization pass tests (``bigdl_tpu/nn/fuse.py``): sibling-conv
+merging must be exact — same outputs, same gradients, merged parameter
+packing — across the Inception block shapes it exists for
+(``models/inception/Inception_v1.scala`` inception fn)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.fuse import merge_sibling_convs, optimize_for_tpu
+from bigdl_tpu.models.inception import build_inception_v1, inception_layer_v1
+from bigdl_tpu.nn.module import state_dict
+from bigdl_tpu.utils.rng import RNG
+
+
+def _forward(m, x):
+    return np.asarray(m.forward(jnp.asarray(x)))
+
+
+def test_inception_block_merge_exact():
+    RNG.set_seed(0)
+    block = inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]], "3a/")
+    x = np.random.randn(2, 192, 14, 14).astype(np.float32)
+    ref = _forward(block, x)
+    fused = merge_sibling_convs(block)
+    # merging regroups the GEMM tiling, so results are close, not
+    # bit-identical
+    np.testing.assert_allclose(_forward(fused, x), ref, rtol=1e-5, atol=1e-6)
+    # three 1x1-leading branches merged into one conv; pool branch kept
+    outer = fused.layers
+    assert len(outer) == 2
+    merged_conv = outer[0].get(0)
+    assert isinstance(merged_conv, nn.SpatialConvolution)
+    assert merged_conv.n_output_plane == 64 + 96 + 16
+
+
+def test_merge_preserves_gradients():
+    RNG.set_seed(1)
+    block = inception_layer_v1(64, [[16], [24, 32], [8, 16], [16]], "g/")
+    x = np.random.randn(2, 64, 9, 9).astype(np.float32)
+    gy = np.random.randn(2, 16 + 32 + 16 + 16, 9, 9).astype(np.float32)
+    g_ref = np.asarray(block.backward(jnp.asarray(x), jnp.asarray(gy)))
+    RNG.set_seed(1)
+    block2 = merge_sibling_convs(
+        inception_layer_v1(64, [[16], [24, 32], [8, 16], [16]], "g/"))
+    g_fused = np.asarray(block2.backward(jnp.asarray(x), jnp.asarray(gy)))
+    np.testing.assert_allclose(g_fused, g_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_merge_param_count_preserved():
+    RNG.set_seed(2)
+    plain = inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]], "p/")
+    n_plain = sum(int(np.prod(v.shape)) for v in state_dict(plain).values())
+    fused = merge_sibling_convs(
+        inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]], "p/"))
+    n_fused = sum(int(np.prod(v.shape)) for v in state_dict(fused).values())
+    assert n_plain == n_fused
+
+
+def test_full_model_merge_and_train_step():
+    RNG.set_seed(3)
+    model = optimize_for_tpu(build_inception_v1(10))
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.parallel.train_step import TrainStep
+
+    step = TrainStep(model, nn.ClassNLLCriterion(),
+                     optim.SGD(learning_rate=0.01))
+    x = jnp.asarray(np.random.randn(2, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(np.random.randint(0, 10, 2))
+    loss = step.run(x, y, jax.random.key(0))
+    assert np.isfinite(float(loss))
+
+
+def test_no_merge_when_signatures_differ():
+    c = nn.Concat(1)
+    c.add(nn.SpatialConvolution(8, 4, 1, 1))
+    c.add(nn.SpatialConvolution(8, 4, 3, 3, 1, 1, 1, 1))  # different kernel
+    merge_sibling_convs(c)
+    assert len(c.layers) == 2
+    assert all(isinstance(b, nn.SpatialConvolution) for b in c.layers)
+
+
+def test_no_merge_on_frozen_or_regularized():
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+
+    c = nn.Concat(1)
+    c.add(nn.SpatialConvolution(8, 4, 1, 1))
+    frozen = nn.SpatialConvolution(8, 4, 1, 1)
+    frozen.freeze()
+    c.add(frozen)
+    merge_sibling_convs(c)
+    assert len(c.layers) == 2  # frozen branch blocks the merge
+
+    c2 = nn.Concat(1)
+    c2.add(nn.SpatialConvolution(8, 4, 1, 1,
+                                 w_regularizer=L2Regularizer(1e-4)))
+    c2.add(nn.SpatialConvolution(8, 4, 1, 1))
+    merge_sibling_convs(c2)
+    assert len(c2.layers) == 2
+
+
+def test_merge_wrong_axis_skipped():
+    c = nn.Concat(2)  # concat along H, not channels
+    c.add(nn.SpatialConvolution(8, 4, 1, 1))
+    c.add(nn.SpatialConvolution(8, 4, 1, 1))
+    merge_sibling_convs(c)
+    assert len(c.layers) == 2
+
+
+def _bn_with_stats(ch, seed):
+    r = np.random.default_rng(seed)
+    bn = nn.SpatialBatchNormalization(ch)
+    bn.weight = jnp.asarray(r.normal(1.0, 0.2, ch).astype(np.float32))
+    bn.bias = jnp.asarray(r.normal(0.0, 0.1, ch).astype(np.float32))
+    bn.running_mean = jnp.asarray(r.normal(0.0, 0.5, ch).astype(np.float32))
+    bn.running_var = jnp.asarray(r.uniform(0.5, 2.0, ch).astype(np.float32))
+    return bn
+
+
+def test_fold_batchnorm_matches_eval_forward():
+    from bigdl_tpu.nn.fuse import fold_batchnorm
+
+    RNG.set_seed(4)
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1), _bn_with_stats(8, 0),
+        nn.ReLU(True),
+        nn.SpatialConvolution(8, 6, 1, 1), _bn_with_stats(6, 1))
+    model.evaluate()
+    x = np.random.randn(2, 3, 10, 10).astype(np.float32)
+    ref = _forward(model, x)
+    fold_batchnorm(model)
+    assert len(model.layers) == 3  # both BNs folded away
+    np.testing.assert_allclose(_forward(model, x), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_batchnorm_nested_containers():
+    from bigdl_tpu.nn.fuse import fold_batchnorm
+
+    RNG.set_seed(5)
+    inner = nn.Sequential(nn.SpatialConvolution(4, 4, 3, 3, 1, 1, 1, 1),
+                          _bn_with_stats(4, 2), nn.ReLU(True))
+    model = nn.Sequential(nn.Concat(1).add(inner).add(nn.Identity()))
+    model.evaluate()
+    x = np.random.randn(2, 4, 6, 6).astype(np.float32)
+    ref = _forward(model, x)
+    fold_batchnorm(model)
+    assert len(inner.layers) == 2
+    np.testing.assert_allclose(_forward(model, x), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_batchnorm_biasless_conv():
+    """conv(bias=False)+BN — the conventional pairing — folds by
+    materializing the bias."""
+    from bigdl_tpu.nn.fuse import fold_batchnorm
+
+    RNG.set_seed(7)
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1, with_bias=False),
+        _bn_with_stats(8, 4))
+    model.evaluate()
+    x = np.random.randn(2, 3, 10, 10).astype(np.float32)
+    ref = _forward(model, x)
+    fold_batchnorm(model)
+    assert len(model.layers) == 1
+    assert model.get(0).with_bias
+    np.testing.assert_allclose(_forward(model, x), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_batchnorm_skips_non_adjacent():
+    from bigdl_tpu.nn.fuse import fold_batchnorm
+
+    RNG.set_seed(6)
+    model = nn.Sequential(nn.SpatialConvolution(3, 5, 1, 1), nn.ReLU(True),
+                          _bn_with_stats(5, 3))
+    fold_batchnorm(model)
+    assert len(model.layers) == 3  # ReLU between conv and BN: no fold

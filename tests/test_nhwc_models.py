@@ -1,0 +1,62 @@
+"""NHWC (channels-last) model-zoo coverage: the layout variant of
+Inception v1 (``models/inception.py`` ``format="NHWC"``) must thread the
+format through EVERY spatial layer and agree with the NCHW build on
+transposed inputs (same parameters — the conv transposes weights
+internally)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models.inception import build_inception_v1, inception_layer_v1
+from bigdl_tpu.nn.module import Container, load_state_dict, state_dict
+from bigdl_tpu.utils.rng import RNG
+
+
+def _formats(model):
+    """Every `format` attribute in the module tree."""
+    found = []
+
+    def walk(m):
+        fmt = m.__dict__.get("format")
+        if isinstance(fmt, str):
+            found.append((type(m).__name__, fmt))
+        if isinstance(m, Container):
+            for child in m.layers:
+                walk(child)
+
+    walk(model)
+    return found
+
+
+def test_nhwc_threads_every_spatial_layer():
+    for with_aux in (False, True):
+        model = build_inception_v1(10, with_aux=with_aux, format="NHWC")
+        fmts = _formats(model)
+        assert fmts, "no format-bearing layers found"
+        wrong = [(n, f) for n, f in fmts if f != "NHWC"]
+        assert not wrong, f"layers left on NCHW: {wrong}"
+
+
+def test_nhwc_stack_matches_nchw():
+    """Forward equivalence over the layer kinds Inception composes:
+    conv, ceil-mode maxpool, LRN, inception block, avg pool."""
+    RNG.set_seed(0)
+    def build(fmt):
+        return nn.Sequential(
+            nn.SpatialConvolution(3, 16, 3, 3, 2, 2, 1, 1, format=fmt),
+            nn.ReLU(True),
+            nn.SpatialMaxPooling(3, 3, 2, 2, format=fmt).ceil(),
+            nn.SpatialCrossMapLRN(5, 0.0001, 0.75, format=fmt),
+            inception_layer_v1(16, [[8], [8, 12], [4, 8], [8]], "b/", fmt),
+            nn.SpatialAveragePooling(3, 3, 2, 2, format=fmt),
+        )
+
+    m_c = build("NCHW")
+    m_l = build("NHWC")
+    load_state_dict(m_l, state_dict(m_c))
+    x = np.random.randn(2, 3, 33, 33).astype(np.float32)
+    out_c = np.asarray(m_c.forward(jnp.asarray(x)))
+    out_l = np.asarray(m_l.forward(jnp.asarray(x.transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(out_l.transpose(0, 3, 1, 2), out_c,
+                               rtol=1e-5, atol=1e-6)
