@@ -96,27 +96,35 @@ def build_inception_v1(class_num: int = 1000, has_dropout: bool = True,
     if not with_aux:
         return nn.Sequential(feature1, feature2, feature3, head)
 
-    def aux_head(in_ch: int, name: str) -> nn.Module:
-        return nn.Sequential(
-            nn.SpatialAveragePooling(5, 5, 3, 3, format=f).ceil(),
-            nn.SpatialConvolution(in_ch, 128, 1, 1, 1, 1, format=f)
-            .set_name(name + "/conv"),
-            nn.ReLU(True),
-            nn.View(128 * 4 * 4).set_num_input_dims(3),
-            nn.Linear(128 * 4 * 4, 1024).set_name(name + "/fc"),
-            nn.ReLU(True),
-            nn.Dropout(0.7),
-            nn.Linear(1024, class_num).set_name(name + "/classifier"),
-            nn.LogSoftMax(),
-        )
-
     # training graph with aux classifiers: outputs (main, aux1, aux2)
     split1 = nn.ConcatTable().add(nn.Sequential(feature2,
                                                 nn.ConcatTable().add(nn.Sequential(feature3, head))
-                                                .add(aux_head(528, "loss2"))))\
-                             .add(aux_head(512, "loss1"))
+                                                .add(_aux_head(528, "loss2", class_num, f))))\
+                             .add(_aux_head(512, "loss1", class_num, f))
     model = nn.Sequential(feature1, split1, nn.FlattenTable())
     return model
+
+
+def _aux_head(in_ch: int, name: str, class_num: int,
+              format: str = "NCHW") -> nn.Module:
+    """Auxiliary classifier (``Inception_v1.scala`` loss1/loss2 branches).
+    The NHWC variant transposes back to channel-first before the flatten
+    so the fc weights index features in the SAME order as the NCHW build
+    — keeping checkpoints portable across layouts."""
+    head = nn.Sequential(
+        nn.SpatialAveragePooling(5, 5, 3, 3, format=format).ceil(),
+        nn.SpatialConvolution(in_ch, 128, 1, 1, 1, 1, format=format)
+        .set_name(name + "/conv"),
+        nn.ReLU(True))
+    if format == "NHWC":
+        head.add(nn.Transpose([(1, 3), (2, 3)]))  # NHWC -> NCHW flatten order
+    head.add(nn.View(128 * 4 * 4).set_num_input_dims(3))
+    head.add(nn.Linear(128 * 4 * 4, 1024).set_name(name + "/fc"))
+    head.add(nn.ReLU(True))
+    head.add(nn.Dropout(0.7))
+    head.add(nn.Linear(1024, class_num).set_name(name + "/classifier"))
+    head.add(nn.LogSoftMax())
+    return head
 
 
 def _conv_bn(input_size, output_size, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):

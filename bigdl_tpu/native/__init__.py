@@ -36,6 +36,7 @@ _LIB_PATH = os.path.join(_DIR, "libbigdl_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_disabled = False  # no_native seen once -> short-circuit (hot paths)
 
 
 def _try_load() -> Optional[ctypes.CDLL]:
@@ -43,7 +44,15 @@ def _try_load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     if _lib is not None:
         return _lib
-    if _build_failed or os.environ.get("BIGDL_TPU_NO_NATIVE"):
+    global _disabled
+    if _build_failed or _disabled:
+        return None
+    from bigdl_tpu.utils.config import get_config
+
+    if get_config().no_native:
+        # cache the decision: _try_load sits on per-record hot paths
+        # (crc32c framing), so don't re-resolve the config every call
+        _disabled = True
         return None
     with _lock:
         if _lib is not None:

@@ -34,6 +34,7 @@ from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.parallel.train_step import EvalStep, TrainStep
+from bigdl_tpu.utils.config import get_config
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.rng import RNG
 
@@ -296,8 +297,9 @@ class Optimizer:
 
     # -- the loop ----------------------------------------------------------
     def optimize(self):
-        retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", "5"))
-        retry_window = float(os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", "120"))
+        cfg = get_config()
+        retry_times = cfg.failure_retry_times
+        retry_window = cfg.failure_retry_interval
         failures: List[float] = []
         self._init_checkpoint_dir()
         while True:
@@ -353,8 +355,9 @@ class Optimizer:
 
         # profiler hook: BIGDL_PROFILE=<dir> traces the first
         # BIGDL_PROFILE_ITERS iterations (jax.profiler, op-level timings)
-        profile_dir = os.environ.get("BIGDL_PROFILE")
-        profile_iters = int(os.environ.get("BIGDL_PROFILE_ITERS", "5"))
+        cfg = get_config()
+        profile_dir = cfg.profile_dir
+        profile_iters = cfg.profile_iters
         profiling = False
         first_iteration = True
 
@@ -470,7 +473,7 @@ class Optimizer:
         armed after 5 samples) — the host-level analogue of the
         reference's kth-largest adaptive threshold
         (``DistriOptimizer.scala:339-367``, ``Util.kthLargest``)."""
-        spec = os.environ.get("BIGDL_ITERATION_TIMEOUT", "").strip()
+        spec = get_config().iteration_timeout
         if not spec or spec == "0":
             return None
         if spec == "auto":

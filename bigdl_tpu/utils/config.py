@@ -1,0 +1,108 @@
+"""Unified typed runtime configuration.
+
+The reference configures its runtime through JVM system properties read
+ad hoc all over the codebase (``utils/Engine.scala:113-154`` ``bigdl.*``
+properties); the TPU-native equivalent is the ``BIGDL_*`` environment.
+This module gives that surface ONE typed, documented object: every knob
+the framework reads, its type, default, and consumer, resolved in a
+single place.  Call sites keep reading through :func:`get_config` so a
+test (or an embedding application) can inject overrides with
+:func:`set_config` instead of mutating ``os.environ``.
+
+| field                  | env var                     | consumer |
+|------------------------|-----------------------------|----------|
+| coordinator_address    | BIGDL_COORDINATOR_ADDRESS   | Engine (multi-host control plane) |
+| num_processes          | BIGDL_NUM_PROCESSES         | Engine |
+| process_id             | BIGDL_PROCESS_ID            | Engine |
+| node_number            | BIGDL_NODE_NUMBER           | Engine (defaults to process count) |
+| core_number            | BIGDL_CORE_NUMBER           | Engine (host cores for data pipeline) |
+| default_pool_size      | BIGDL_DEFAULT_POOL_SIZE     | Engine.default thread pool |
+| local_mode             | BIGDL_LOCAL_MODE            | Engine |
+| failure_retry_times    | BIGDL_FAILURE_RETRY_TIMES   | Optimizer retry budget |
+| failure_retry_interval | BIGDL_FAILURE_RETRY_INTERVAL| Optimizer retry window (s) |
+| iteration_timeout      | BIGDL_ITERATION_TIMEOUT     | straggler guard ("", "0", float, "auto") |
+| profile_dir            | BIGDL_PROFILE               | profiler hook |
+| profile_iters          | BIGDL_PROFILE_ITERS         | profiler hook |
+| no_native              | BIGDL_TPU_NO_NATIVE         | native kernel loader |
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+__all__ = ["BigDLConfig", "get_config", "set_config"]
+
+
+def _truthy(v: Optional[str]) -> bool:
+    return (v or "").lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class BigDLConfig:
+    # multi-host control plane
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    # host topology
+    node_number: Optional[int] = None
+    core_number: Optional[int] = None
+    default_pool_size: Optional[int] = None
+    local_mode: bool = False
+    # failure handling
+    failure_retry_times: int = 5
+    failure_retry_interval: float = 120.0
+    iteration_timeout: str = ""  # "", "0", "<seconds>", or "auto"
+    # profiling
+    profile_dir: Optional[str] = None
+    profile_iters: int = 5
+    # native layer
+    no_native: bool = False
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "BigDLConfig":
+        def _int(name, default):
+            v = env.get(name)
+            return int(v) if v else default
+
+        def _float(name, default):
+            v = env.get(name)
+            return float(v) if v else default
+
+        return cls(
+            coordinator_address=env.get("BIGDL_COORDINATOR_ADDRESS") or None,
+            num_processes=_int("BIGDL_NUM_PROCESSES", 1),
+            process_id=_int("BIGDL_PROCESS_ID", 0),
+            node_number=_int("BIGDL_NODE_NUMBER", 0) or None,
+            core_number=_int("BIGDL_CORE_NUMBER", 0) or None,
+            default_pool_size=_int("BIGDL_DEFAULT_POOL_SIZE", 0) or None,
+            local_mode=_truthy(env.get("BIGDL_LOCAL_MODE")),
+            failure_retry_times=_int("BIGDL_FAILURE_RETRY_TIMES", 5),
+            failure_retry_interval=_float("BIGDL_FAILURE_RETRY_INTERVAL", 120.0),
+            iteration_timeout=(env.get("BIGDL_ITERATION_TIMEOUT") or "").strip(),
+            profile_dir=env.get("BIGDL_PROFILE") or None,
+            profile_iters=_int("BIGDL_PROFILE_ITERS", 5),
+            no_native=_truthy(env.get("BIGDL_TPU_NO_NATIVE")),
+        )
+
+
+_config: Optional[BigDLConfig] = None
+
+
+def get_config() -> BigDLConfig:
+    """The process-wide config.  An explicitly installed config
+    (:func:`set_config`) wins; otherwise the environment is re-resolved
+    on each call — call sites read it once per operation (not per
+    iteration), so env mutations (e.g. in tests) take effect at the next
+    operation boundary."""
+    if _config is not None:
+        return _config
+    return BigDLConfig.from_env()
+
+
+def set_config(cfg: Optional[BigDLConfig]) -> None:
+    """Install an explicit config (tests / embedding apps); ``None``
+    reverts to env resolution on next :func:`get_config`."""
+    global _config
+    _config = cfg

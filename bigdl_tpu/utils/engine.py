@@ -31,12 +31,9 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from bigdl_tpu.utils.config import get_config
+
 __all__ = ["Engine"]
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return int(v) if v else default
 
 
 class _Engine:
@@ -50,7 +47,12 @@ class _Engine:
         self._process_index = 0
         self._distributed = False
         self._pool: Optional[ThreadPoolExecutor] = None
-        self.local_mode = os.environ.get("BIGDL_LOCAL_MODE", "").lower() in ("1", "true")
+
+    @property
+    def local_mode(self) -> bool:
+        # read per use, not baked into the import-time singleton, so
+        # set_config()/env overrides behave like every other knob
+        return get_config().local_mode
 
     # -- multi-host ---------------------------------------------------------
     def _init_distributed(self):
@@ -59,13 +61,13 @@ class _Engine:
         ``jax.distributed`` as the control plane instead of Spark."""
         import jax
 
-        coord = os.environ.get("BIGDL_COORDINATOR_ADDRESS")
-        if coord is None or self._distributed:
+        cfg = get_config()
+        if cfg.coordinator_address is None or self._distributed:
             return
         jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=_env_int("BIGDL_NUM_PROCESSES", 1),
-            process_id=_env_int("BIGDL_PROCESS_ID", 0))
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id)
         self._distributed = True
 
     # -- init ---------------------------------------------------------------
@@ -90,11 +92,12 @@ class _Engine:
         from jax.sharding import Mesh
 
         self._mesh = Mesh(arr, tuple(axis_names))
+        cfg = get_config()
         self._process_count = jax.process_count()
         self._process_index = jax.process_index()
-        self._node_number = _env_int("BIGDL_NODE_NUMBER", self._process_count)
-        self._core_number = _env_int("BIGDL_CORE_NUMBER", os.cpu_count() or 1)
-        pool_size = _env_int("BIGDL_DEFAULT_POOL_SIZE", max(4, self._core_number))
+        self._node_number = cfg.node_number or self._process_count
+        self._core_number = cfg.core_number or os.cpu_count() or 1
+        pool_size = cfg.default_pool_size or max(4, self._core_number)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         self._pool = ThreadPoolExecutor(max_workers=pool_size, thread_name_prefix="bigdl")

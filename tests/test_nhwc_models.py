@@ -8,7 +8,8 @@ import numpy as np
 import jax.numpy as jnp
 
 import bigdl_tpu.nn as nn
-from bigdl_tpu.models.inception import build_inception_v1, inception_layer_v1
+from bigdl_tpu.models.inception import (_aux_head, build_inception_v1,
+                                        inception_layer_v1)
 from bigdl_tpu.nn.module import Container, load_state_dict, state_dict
 from bigdl_tpu.utils.rng import RNG
 
@@ -60,3 +61,29 @@ def test_nhwc_stack_matches_nchw():
     out_l = np.asarray(m_l.forward(jnp.asarray(x.transpose(0, 2, 3, 1))))
     np.testing.assert_allclose(out_l.transpose(0, 3, 1, 2), out_c,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_nhwc_aux_head_matches_nchw_with_shared_weights():
+    """The aux classifier flattens spatial maps into an fc — the NHWC
+    build must transpose back to channel-first before the flatten so the
+    SAME fc weights produce the SAME logits (checkpoint portability
+    across layouts)."""
+    RNG.set_seed(1)
+    h_c = _aux_head(32, "loss1", 7, "NCHW").evaluate()
+    RNG.set_seed(2)
+    h_l = _aux_head(32, "loss1", 7, "NHWC").evaluate()
+    # the NHWC build has an extra (parameterless) Transpose, so positional
+    # state paths shift by one — map parameters in traversal order
+    src, dst = state_dict(h_c), state_dict(h_l)
+    assert len(src) == len(dst)
+    def _key(p):
+        head, leaf = p.split(".", 1)
+        return (int(head), leaf)
+    remapped = {dk: src[sk] for sk, dk in
+                zip(sorted(src, key=_key), sorted(dst, key=_key))}
+    load_state_dict(h_l, remapped)
+    # aux pool 5x5 stride 3 over a 14x14 map -> 4x4, as in the real model
+    x = np.random.randn(2, 32, 14, 14).astype(np.float32)
+    out_c = np.asarray(h_c.forward(jnp.asarray(x)))
+    out_l = np.asarray(h_l.forward(jnp.asarray(x.transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(out_l, out_c, rtol=1e-5, atol=1e-6)
