@@ -20,7 +20,7 @@ from bigdl_tpu.utils.rng import next_rng_id, require_rng
 
 __all__ = [
     "ReLU", "ReLU6", "PReLU", "RReLU", "LeakyReLU", "ELU", "Tanh",
-    "TanhShrink", "Sigmoid", "LogSigmoid", "SoftMax", "SoftMin",
+    "TanhShrink", "Sigmoid", "LogSigmoid", "SoftSign", "SoftMax", "SoftMin",
     "LogSoftMax", "SoftPlus", "SoftShrink", "HardShrink", "HardTanh",
     "Clamp", "Threshold", "Power", "Square", "Sqrt", "Log", "Exp", "Abs",
     "GradientReversal",
@@ -164,6 +164,13 @@ class Sigmoid(Module):
 class LogSigmoid(Module):
     def update_output(self, input):
         return jax.nn.log_sigmoid(input)
+
+
+class SoftSign(Module):
+    """x / (1 + |x|) (``nn/SoftSign.scala:31``)."""
+
+    def update_output(self, input):
+        return input / (1.0 + jnp.abs(input))
 
 
 class SoftMax(Module):

@@ -48,6 +48,7 @@ _SCAN_MODULES = (
     "bigdl_tpu.nn.graph",
     "bigdl_tpu.nn.init",
     "bigdl_tpu.nn.criterion",
+    "bigdl_tpu.nn.fuse",
     "bigdl_tpu.optim.optim_method",
     "bigdl_tpu.optim.regularizer",
     "bigdl_tpu.models.transformer",

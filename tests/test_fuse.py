@@ -168,6 +168,106 @@ def test_fold_batchnorm_biasless_conv():
     np.testing.assert_allclose(_forward(model, x), ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("h,w,k,s,p", [
+    (224, 224, 7, 2, 3),   # the ImageNet conv1 shape
+    (11, 11, 2, 2, 0),     # trailing row cropped (negative hi pad)
+    (15, 13, 5, 3, 2),     # stride 3, asymmetric spatial extents
+])
+def test_space_to_depth_input_exact(h, w, k, s, p):
+    from bigdl_tpu.nn.fuse import space_to_depth_input
+
+    RNG.set_seed(8)
+    conv = nn.SpatialConvolution(3, 8, k, k, s, s, p, p)
+    ref_model = nn.Sequential(conv, nn.ReLU(True))
+    x = np.random.randn(2, 3, h, w).astype(np.float32)
+    ref = _forward(ref_model, x)
+    # grads of the ORIGINAL parameterization
+    gy = np.random.randn(*ref.shape).astype(np.float32)
+    ref_model.zero_grad_parameters()
+    ref_model.backward(jnp.asarray(x), jnp.asarray(gy))
+    g_ref = np.asarray(conv._grads["weight"])
+
+    RNG.set_seed(8)
+    conv2 = nn.SpatialConvolution(3, 8, k, k, s, s, p, p)
+    model = space_to_depth_input(nn.Sequential(conv2, nn.ReLU(True)))
+    out = _forward(model, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # training equivalence: dead slots stay zero, live slots get the
+    # SAME gradients as the original packing
+    inner = model.get(0)
+    new_conv = inner.get(1)
+    model.zero_grad_parameters()
+    model.backward(jnp.asarray(x), jnp.asarray(gy))
+    gw = np.asarray(new_conv._grads["weight"])
+    mask = np.asarray(new_conv.weight_mask)[0]
+    assert np.all(gw[:, mask == 0] == 0), "dead slots received gradient"
+    # scatter the original grad into the repacked layout and compare
+    kp = -(-k // s)
+    for a_h in range(s):
+        for a_w in range(s):
+            for j_h in range(kp):
+                dy = s * j_h + a_h
+                if dy >= k:
+                    continue
+                for j_w in range(kp):
+                    dx = s * j_w + a_w
+                    if dx >= k:
+                        continue
+                    ch = (np.arange(3) * s + a_h) * s + a_w
+                    np.testing.assert_allclose(
+                        gw[:, ch, j_h, j_w], g_ref[:, :, dy, dx],
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_space_to_depth_skips_wide_input_convs():
+    from bigdl_tpu.nn.fuse import space_to_depth_input
+
+    model = nn.Sequential(nn.SpatialConvolution(64, 64, 3, 3, 2, 2, 1, 1))
+    assert space_to_depth_input(model) is model
+    assert isinstance(model.get(0), nn.SpatialConvolution)
+
+
+def test_space_to_depth_skips_same_padding():
+    """pad == -1 (SAME) has different output-size math — must not rewrite."""
+    from bigdl_tpu.nn.fuse import space_to_depth_input
+
+    model = nn.Sequential(nn.SpatialConvolution(3, 8, 7, 7, 2, 2, -1, -1))
+    ref = _forward(model, np.random.randn(2, 3, 32, 32).astype(np.float32))
+    assert space_to_depth_input(model) is model
+    assert isinstance(model.get(0), nn.SpatialConvolution)
+    assert ref.shape == (2, 8, 16, 16)
+
+
+def test_space_to_depth_unbatched_input():
+    from bigdl_tpu.nn.fuse import space_to_depth_input
+
+    RNG.set_seed(9)
+    conv = nn.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3)
+    x3 = np.random.randn(3, 32, 32).astype(np.float32)
+    ref = np.asarray(conv.forward(jnp.asarray(x3)))
+    RNG.set_seed(9)
+    model = space_to_depth_input(nn.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3))
+    out = np.asarray(model.forward(jnp.asarray(x3)))
+    assert out.shape == ref.shape == (8, 16, 16)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_space_to_depth_model_serializes():
+    """optimize_for_tpu output must stay BTPU-persistable (checkpoints)."""
+    from bigdl_tpu.nn.fuse import space_to_depth_input
+    from bigdl_tpu.utils import module_format
+
+    RNG.set_seed(10)
+    model = space_to_depth_input(nn.Sequential(
+        nn.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3), nn.ReLU(True)))
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    ref = _forward(model, x)
+    blob = module_format.dumps(model)
+    loaded = module_format.loads(blob)
+    np.testing.assert_array_equal(_forward(loaded, x), ref)
+
+
 def test_fold_batchnorm_skips_non_adjacent():
     from bigdl_tpu.nn.fuse import fold_batchnorm
 

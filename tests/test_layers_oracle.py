@@ -38,6 +38,7 @@ ACT_CASES = [
     (nn.SoftPlus(2.0), lambda x: F.softplus(x, beta=2.0)),
     (nn.LogSigmoid(), F.logsigmoid),
     (nn.TanhShrink(), F.tanhshrink),
+    (nn.SoftSign(), F.softsign),
     (nn.SoftShrink(0.4), lambda x: F.softshrink(x, 0.4)),
     (nn.HardShrink(0.4), lambda x: F.hardshrink(x, 0.4)),
     (nn.HardTanh(-2.0, 2.0), lambda x: F.hardtanh(x, -2.0, 2.0)),
