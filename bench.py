@@ -102,6 +102,16 @@ def peak_flops_per_sec():
     return None
 
 
+def make_drain(step):
+    """Value-fetch sync: a params-derived scalar forces every queued
+    dispatch INCLUDING its optimizer updates (the loss alone only depends
+    on params from the previous iteration).  Shared with
+    ``tools/scaling_bench.py`` so the timing protocol stays in one place."""
+    def drain():
+        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    return drain
+
+
 def run_config(name, build_model, build_batch, criterion, batch, iters):
     import bigdl_tpu.optim as optim
     from bigdl_tpu.parallel.train_step import TrainStep
@@ -128,11 +138,7 @@ def run_config(name, build_model, build_batch, criterion, batch, iters):
     if cost and cost.get("flops"):
         flops = float(cost["flops"])
 
-    def drain():
-        # value-fetch sync: a params-derived scalar forces every queued
-        # dispatch INCLUDING its optimizer updates (the loss alone only
-        # depends on params from the previous iteration)
-        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    drain = make_drain(step)
 
     losses = step.run_scan(x, y, jax.random.key(1), iters)  # warmup
     if not bool(jnp.isfinite(losses).all()):
