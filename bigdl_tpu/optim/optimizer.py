@@ -298,6 +298,10 @@ class Optimizer:
     # -- the loop ----------------------------------------------------------
     def optimize(self):
         cfg = get_config()
+        # two device clients on one chip deadlock in claim — detect the
+        # second driver up front (Engine.checkSingleton parity,
+        # DistriOptimizer.scala:543-554)
+        Engine.check_singleton()
         retry_times = cfg.failure_retry_times
         retry_window = cfg.failure_retry_interval
         failures: List[float] = []

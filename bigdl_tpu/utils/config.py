@@ -21,6 +21,7 @@ test (or an embedding application) can inject overrides with
 | failure_retry_times    | BIGDL_FAILURE_RETRY_TIMES   | Optimizer retry budget |
 | failure_retry_interval | BIGDL_FAILURE_RETRY_INTERVAL| Optimizer retry window (s) |
 | iteration_timeout      | BIGDL_ITERATION_TIMEOUT     | straggler guard ("", "0", float, "auto") |
+| check_singleton_strict | BIGDL_CHECK_SINGLETON       | Engine.check_singleton raise-vs-warn |
 | profile_dir            | BIGDL_PROFILE               | profiler hook |
 | profile_iters          | BIGDL_PROFILE_ITERS         | profiler hook |
 | no_native              | BIGDL_TPU_NO_NATIVE         | native kernel loader |
@@ -54,6 +55,7 @@ class BigDLConfig:
     failure_retry_times: int = 5
     failure_retry_interval: float = 120.0
     iteration_timeout: str = ""  # "", "0", "<seconds>", or "auto"
+    check_singleton_strict: bool = False  # BIGDL_CHECK_SINGLETON: raise vs warn
     # profiling
     profile_dir: Optional[str] = None
     profile_iters: int = 5
@@ -81,6 +83,7 @@ class BigDLConfig:
             failure_retry_times=_int("BIGDL_FAILURE_RETRY_TIMES", 5),
             failure_retry_interval=_float("BIGDL_FAILURE_RETRY_INTERVAL", 120.0),
             iteration_timeout=(env.get("BIGDL_ITERATION_TIMEOUT") or "").strip(),
+            check_singleton_strict=_truthy(env.get("BIGDL_CHECK_SINGLETON")),
             profile_dir=env.get("BIGDL_PROFILE") or None,
             profile_iters=_int("BIGDL_PROFILE_ITERS", 5),
             no_native=_truthy(env.get("BIGDL_TPU_NO_NATIVE")),

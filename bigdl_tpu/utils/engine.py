@@ -47,6 +47,7 @@ class _Engine:
         self._process_index = 0
         self._distributed = False
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._singleton_fd: Optional[int] = None
 
     @property
     def local_mode(self) -> bool:
@@ -82,6 +83,10 @@ class _Engine:
         """
         import jax
 
+        # BEFORE the first jax.devices(): a second driver must be caught
+        # while this process can still report it rather than hang in the
+        # device claim (see check_singleton)
+        self.check_singleton()
         self._init_distributed()
         self._devices = list(devices) if devices is not None else jax.devices()
         n = len(self._devices)
@@ -166,9 +171,74 @@ class _Engine:
         futures = [self._pool.submit(f) for f in fns]
         return [f.result(timeout=timeout) for f in futures]
 
+    # -- singleton guard ----------------------------------------------------
+    def _singleton_lock_path(self) -> str:
+        """Lock identity WITHOUT touching jax (initializing the backend
+        IS the device claim the guard exists to protect): platform name,
+        visible-device restriction, and the configured process slot."""
+        import tempfile
+
+        parts = [os.environ.get("JAX_PLATFORMS") or "default",
+                 os.environ.get("TPU_VISIBLE_DEVICES", ""),
+                 f"p{get_config().process_id}"]
+        tag = "".join(c if c.isalnum() or c in "p_" else "_"
+                      for c in "_".join(parts))
+        return os.path.join(tempfile.gettempdir(), f"bigdl_tpu_{tag}.lock")
+
+    def check_singleton(self, raise_on_conflict: Optional[bool] = None) -> bool:
+        """Detect a SECOND process about to drive the same accelerator —
+        the reference's ``Engine.checkSingleton`` (``Engine.scala:165``,
+        enforced at ``DistriOptimizer.scala:543-554``) which catches two
+        task-sets sharing one JVM.  The TPU failure mode is two host
+        processes contending for one chip's PJRT client: the loser
+        blocks indefinitely in device claim, which looks exactly like a
+        hang — so this guard deliberately never touches jax itself
+        (``Engine.init`` runs it BEFORE the first ``jax.devices()``).
+        Advisory ``flock`` on a per-platform, per-process-slot lockfile,
+        released on process exit.
+
+        Returns True when this process holds (or newly acquired) the
+        lock, or when the lockfile is unusable (permissions on a shared
+        tmpdir) — the guard is advisory, never a new failure mode.  On
+        conflict: warns and returns False, or raises when
+        ``raise_on_conflict`` (default: the ``BIGDL_CHECK_SINGLETON``
+        config, mirroring ``bigdl.check.singleton``) is true."""
+        import fcntl
+        import logging
+
+        log = logging.getLogger("bigdl_tpu")
+        if self._singleton_fd is not None:
+            return True
+        if raise_on_conflict is None:
+            raise_on_conflict = get_config().check_singleton_strict
+        path = self._singleton_lock_path()
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        except OSError as e:
+            log.warning(f"singleton check skipped: cannot open {path}: {e}")
+            return True
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            msg = (f"another process already drives this platform "
+                   f"(lock {path}); two device clients on one chip "
+                   f"deadlock in claim")
+            if raise_on_conflict:
+                raise RuntimeError(msg) from None
+            log.warning(msg)
+            return False
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        self._singleton_fd = fd
+        return True
+
     def reset(self):
         self._initialized = False
         self._mesh = None
+        if self._singleton_fd is not None:
+            os.close(self._singleton_fd)  # closing drops the flock
+            self._singleton_fd = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
