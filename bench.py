@@ -56,9 +56,12 @@ def _configs():
         y = jnp.asarray(rng.integers(0, classes, batch))
         return x, y
 
-    def tokens(batch, seq, vocab, classes):
+    def tokens(batch, seq, vocab, classes, seq_targets=False):
         x = jnp.asarray(rng.integers(0, vocab, (batch, seq), dtype=np.int32))
-        y = jnp.asarray(rng.integers(0, classes, batch))
+        if seq_targets:  # LM: a target token per position
+            y = jnp.asarray(rng.integers(0, classes, (batch, seq), dtype=np.int32))
+        else:
+            y = jnp.asarray(rng.integers(0, classes, batch))
         return x, y
 
     return {
@@ -85,6 +88,14 @@ def _configs():
         "resnet50_imagenet": (
             lambda: models.build_resnet(50, 1000),
             lambda b: img(b, 3, 224, 224, 1000), nn.ClassNLLCriterion(), 128),
+        # decoder-only LM through the Pallas flash-attention path:
+        # [batch, seq] tokens -> per-position next-token NLL
+        "transformer_lm": (
+            lambda: models.build_transformer_lm(
+                32000, num_layers=6, embed_dim=512, num_heads=8, max_len=512),
+            lambda b: tokens(b, 512, 32000, 32000, seq_targets=True),
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True),
+            32),
     }
 
 

@@ -36,8 +36,8 @@ def fresh_lock():
 
 
 def test_first_process_acquires(fresh_lock):
-    assert Engine.check_singleton() is True
-    assert Engine.check_singleton() is True  # idempotent while held
+    assert Engine.check_singleton(force=True) is True
+    assert Engine.check_singleton(force=True) is True  # idempotent while held
     # pid recorded for conflict diagnosis
     with open(Engine._singleton_lock_path()) as f:
         assert f.read().strip() == str(os.getpid())
@@ -46,9 +46,10 @@ def test_first_process_acquires(fresh_lock):
 def test_path_derivation_touches_no_jax(fresh_lock, monkeypatch):
     """The lock identity must come from env/config only — initializing a
     backend IS the claim the guard protects against."""
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
     path = Engine._singleton_lock_path()
     assert "bigdl_tpu_" in path
-    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0,1")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "sentinel-0,1")
     assert Engine._singleton_lock_path() != path  # visibility splits the lock
 
 
@@ -58,13 +59,13 @@ def test_conflict_warns_and_raises(fresh_lock):
         stdout=subprocess.PIPE, text=True)
     try:
         assert holder.stdout.readline().strip() == "held"
-        assert Engine.check_singleton() is False  # default: warn
+        assert Engine.check_singleton(force=True) is False  # default: warn
         with pytest.raises(RuntimeError, match="another process"):
-            Engine.check_singleton(raise_on_conflict=True)
+            Engine.check_singleton(raise_on_conflict=True, force=True)
         try:
             set_config(BigDLConfig(check_singleton_strict=True))
             with pytest.raises(RuntimeError):
-                Engine.check_singleton()
+                Engine.check_singleton(force=True)
         finally:
             set_config(None)
     finally:
@@ -75,11 +76,18 @@ def test_conflict_warns_and_raises(fresh_lock):
 def test_unusable_lockfile_is_advisory(fresh_lock, monkeypatch):
     monkeypatch.setattr(Engine, "_singleton_lock_path",
                         lambda: "/nonexistent-dir/x.lock")
-    assert Engine.check_singleton() is True  # skipped, not a failure
+    assert Engine.check_singleton(force=True) is True  # skipped, not a failure
 
 
 def test_lock_released_on_reset(fresh_lock):
-    assert Engine.check_singleton() is True
+    assert Engine.check_singleton(force=True) is True
     Engine.reset()
     assert Engine._singleton_fd is None
-    assert Engine.check_singleton() is True  # reacquirable
+    assert Engine.check_singleton(force=True) is True  # reacquirable
+
+
+def test_cpu_platform_short_circuits(fresh_lock, monkeypatch):
+    """Concurrent CPU-only processes are legitimate — no lock taken."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert Engine.check_singleton() is True
+    assert Engine._singleton_fd is None
