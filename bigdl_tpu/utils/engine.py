@@ -175,8 +175,11 @@ class _Engine:
     def _singleton_platform(self) -> str:
         """Normalized platform tag WITHOUT touching jax (initializing the
         backend IS the device claim the guard exists to protect): first
-        entry of JAX_PLATFORMS, lowercased; empty/unset -> 'default'."""
-        plats = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
+        entry of JAX_PLATFORMS (falling back to the legacy
+        JAX_PLATFORM_NAME alias jax still honors), lowercased;
+        empty/unset -> 'default'."""
+        plats = (os.environ.get("JAX_PLATFORMS")
+                 or os.environ.get("JAX_PLATFORM_NAME") or "").strip().lower()
         return plats.split(",")[0].strip() or "default"
 
     def _singleton_lock_path(self) -> str:

@@ -15,7 +15,9 @@ Supported pipeline ops (the reference's set, ``Session.scala:150-263``):
 ``FIFOQueueV2``/``PaddingFIFOQueueV2``/``RandomShuffleQueueV2`` (+ V1
 names), ``QueueEnqueue(Many)V2``, ``QueueDequeue(Many/UpTo)V2``,
 ``ReaderReadV2`` over ``TFRecordReaderV2``, ``ParseExampleV2`` /
-``ParseSingleExample``, with ``Identity``/control-dep hops between.
+``ParseSingleExample`` / legacy variadic-key ``ParseExample`` (v1), with
+``Identity``/control-dep and shape-only (``Reshape``/``ExpandDims``/
+``Squeeze``) hops between.
 """
 
 from __future__ import annotations
@@ -123,10 +125,22 @@ class TFTrainingSession:
             # output order: sparse_indices*, sparse_values*,
             # sparse_shapes*, dense_values*
             first_dense = 3 * num_sparse
+        elif pe["op"] == "ParseExample":
+            # v1: keys arrive as VARIADIC Const string inputs —
+            # [serialized, names, sparse_keys x Nsparse,
+            #  dense_keys x Ndense, dense_defaults x Ndense]
+            num_sparse = int(a.get("Nsparse") or 0)
+            ndense = int(a.get("Ndense") or 0)
+            keys = []
+            data_ins = [i for i in pe["inputs"] if not i.startswith("^")]
+            for ref in data_ins[2 + num_sparse:2 + num_sparse + ndense]:
+                raw = self._const_of(ref).reshape(-1)[0]
+                keys.append(raw.decode() if isinstance(raw, bytes)
+                            else str(raw))
+            first_dense = 3 * num_sparse
         else:
             raise NotImplementedError(
-                "ParseExample (v1, variadic keys) unsupported; re-export "
-                "with ParseExampleV2/ParseSingleExample")
+                f"unsupported parse op {pe['op']!r}")
         dtypes = a.get("Tdense") or []
         dtypes = [_TF_DTYPES.get(int(d), np.float32) for d in dtypes]
         shapes = a.get("dense_shapes") or [[] for _ in keys]
@@ -135,6 +149,11 @@ class TFTrainingSession:
     def _serialized_source(self, pe: Dict) -> List[str]:
         """The ParseExample's serialized input -> TFRecord filenames."""
         reader = self._follow_identity(pe["inputs"][0])
+        # v1 ParseExample requires a VECTOR serialized input, so graphs
+        # wrap the reader's scalar in shape-only ops — skip through them
+        while reader["op"] in ("Reshape", "ExpandDims", "Squeeze"):
+            data_ins = [i for i in reader["inputs"] if not i.startswith("^")]
+            reader = self._follow_identity(data_ins[0])
         if reader["op"] not in _READER_OPS:
             raise NotImplementedError(
                 f"serialized source {reader['op']} unsupported "
