@@ -106,8 +106,10 @@ class MultiHeadAttention(Module):
                 "backend='flash' does not support masks (only causal=True); "
                 "use backend='dense' or 'auto' for masked attention")
         if backend == "auto":
-            backend = "flash" if (jax.default_backend() == "tpu"
-                                  and mask is None) else "dense"
+            from bigdl_tpu.ops.attention import is_tpu_device
+
+            backend = "flash" if (is_tpu_device() and mask is None) \
+                else "dense"
         if backend == "flash":
             return flash_attention(q, k, v, causal=self.causal)
         return dot_product_attention(q, k, v, mask=mask, causal=self.causal)

@@ -29,6 +29,7 @@ from jax import lax
 __all__ = [
     "dot_product_attention",
     "flash_attention",
+    "is_tpu_device",
     "attention_partial",
     "combine_partials",
 ]
@@ -98,8 +99,24 @@ def combine_partials(state_a, state_b):
 # Pallas flash attention
 # ---------------------------------------------------------------------------
 
+def is_tpu_device() -> bool:
+    """True when the default jax device is TPU hardware.  The check must
+    look at the DEVICE, not ``jax.default_backend()``: proxied TPU
+    plugins (e.g. the axon PJRT tunnel) register under their own
+    platform name, and a name test would silently drop the bench onto
+    the interpreter."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # noqa: BLE001 — no backend at all
+        return False
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    plat = (getattr(dev, "platform", "") or "").lower()
+    return "tpu" in kind or plat == "tpu"
+
+
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    """Mosaic-compile on TPU; Pallas interpret mode elsewhere (tests)."""
+    return not is_tpu_device()
 
 
 # Grid layout: (batch*heads, q_blocks, k_blocks) for fwd/dq and
