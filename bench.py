@@ -14,8 +14,10 @@ The reference publishes no numeric baselines (BASELINE.json
 ``"published": {}``), so vs_baseline is null.
 
 Env knobs: BENCH_CONFIGS=comma,list  BENCH_ITERS,
-BENCH_PEAK_TFLOPS (override the per-chip peak table).  Warmup is one
-full (untimed) scan dispatch — there is no separate warmup knob.
+BENCH_PEAK_TFLOPS (override the per-chip peak table),
+BENCH_BACKEND_TIMEOUT (seconds to wait for backend init before emitting
+a backend_init_failed line, default 300).  Warmup is one full (untimed)
+scan dispatch — there is no separate warmup knob.
 """
 
 import json
@@ -183,7 +185,24 @@ def run_config(name, build_model, build_batch, criterion, batch, iters):
     return out
 
 
+def _init_backend_or_die(timeout_s: float):
+    """Bounded backend init (``Engine.probe_backend``): on a wedged
+    device tunnel emit an explicit one-line JSON error and exit nonzero
+    instead of hanging the driver."""
+    from bigdl_tpu.utils.engine import Engine
+
+    try:
+        Engine.probe_backend(timeout_s)
+    except RuntimeError as e:
+        print(json.dumps({"metric": "backend_init_failed", "value": None,
+                          "unit": "images/sec", "vs_baseline": None,
+                          "error": str(e)}))
+        sys.stdout.flush()
+        os._exit(3)  # probe thread may be stuck in native code
+
+
 def main():
+    _init_backend_or_die(float(os.environ.get("BENCH_BACKEND_TIMEOUT", "300")))
     iters = int(os.environ.get("BENCH_ITERS", "24"))
     cfgs = _configs()
     only = os.environ.get("BENCH_CONFIGS")

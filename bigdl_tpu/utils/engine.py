@@ -249,6 +249,37 @@ class _Engine:
         self._singleton_fd = fd
         return True
 
+    def probe_backend(self, timeout_s: float = 300.0):
+        """Bounded first touch of the jax backend.  PJRT client creation
+        blocks INDEFINITELY on a wedged device tunnel (e.g. a stale pool
+        grant), so drivers call this instead of a bare ``jax.devices()``.
+        Runs :meth:`check_singleton` first — a second-driver conflict
+        must be diagnosed as such, not as a timeout.  Returns the device
+        list; raises ``RuntimeError`` on timeout or backend error."""
+        import threading
+
+        self.check_singleton()
+        done = threading.Event()
+        state: dict = {}
+
+        def probe():
+            try:
+                import jax
+
+                state["devices"] = jax.devices()
+            except Exception as e:  # noqa: BLE001
+                state["error"] = f"{type(e).__name__}: {e}"
+            done.set()
+
+        threading.Thread(target=probe, daemon=True).start()
+        if not done.wait(timeout_s):
+            raise RuntimeError(
+                f"backend init exceeded {timeout_s:.0f}s (wedged device "
+                f"tunnel?); the probe thread is stuck in native code")
+        if "error" in state:
+            raise RuntimeError(f"backend init failed: {state['error']}")
+        return state["devices"]
+
     def reset(self):
         self._initialized = False
         self._mesh = None

@@ -50,7 +50,10 @@ def main():
     from bigdl_tpu.parallel.train_step import TrainStep
     from bigdl_tpu.utils.rng import RNG
 
-    devices = jax.devices()
+    from bigdl_tpu.utils.engine import Engine
+
+    devices = Engine.probe_backend(
+        float(os.environ.get("BENCH_BACKEND_TIMEOUT", "300")))
     n = len(devices)
     nproc = jax.process_count()
     if args.sizes:
