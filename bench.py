@@ -185,14 +185,15 @@ def run_config(name, build_model, build_batch, criterion, batch, iters):
     return out
 
 
-def _init_backend_or_die(timeout_s: float):
-    """Bounded backend init (``Engine.probe_backend``): on a wedged
-    device tunnel emit an explicit one-line JSON error and exit nonzero
-    instead of hanging the driver."""
+def _init_backend_or_die():
+    """Bounded backend init (``Engine.probe_backend``, which owns the
+    BENCH_BACKEND_TIMEOUT knob): on a wedged device tunnel emit an
+    explicit one-line JSON error and exit nonzero instead of hanging
+    the driver."""
     from bigdl_tpu.utils.engine import Engine
 
     try:
-        Engine.probe_backend(timeout_s)
+        Engine.probe_backend()
     except RuntimeError as e:
         print(json.dumps({"metric": "backend_init_failed", "value": None,
                           "unit": "images/sec", "vs_baseline": None,
@@ -202,7 +203,7 @@ def _init_backend_or_die(timeout_s: float):
 
 
 def main():
-    _init_backend_or_die(float(os.environ.get("BENCH_BACKEND_TIMEOUT", "300")))
+    _init_backend_or_die()
     iters = int(os.environ.get("BENCH_ITERS", "24"))
     cfgs = _configs()
     only = os.environ.get("BENCH_CONFIGS")

@@ -249,16 +249,21 @@ class _Engine:
         self._singleton_fd = fd
         return True
 
-    def probe_backend(self, timeout_s: float = 300.0):
+    def probe_backend(self, timeout_s: Optional[float] = None):
         """Bounded first touch of the jax backend.  PJRT client creation
         blocks INDEFINITELY on a wedged device tunnel (e.g. a stale pool
         grant), so drivers call this instead of a bare ``jax.devices()``.
-        Runs :meth:`check_singleton` first — a second-driver conflict
-        must be diagnosed as such, not as a timeout.  Returns the device
-        list; raises ``RuntimeError`` on timeout or backend error."""
+        Runs :meth:`check_singleton` first and RAISES on conflict — a
+        second-driver conflict must be diagnosed as such, not as the
+        timeout it would otherwise become.  ``timeout_s`` defaults to the
+        ``BENCH_BACKEND_TIMEOUT`` env var (300 s).  Returns the device
+        list; raises ``RuntimeError`` on conflict, timeout, or backend
+        error."""
         import threading
 
-        self.check_singleton()
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT", "300"))
+        self.check_singleton(raise_on_conflict=True)
         done = threading.Event()
         state: dict = {}
 

@@ -52,8 +52,7 @@ def main():
 
     from bigdl_tpu.utils.engine import Engine
 
-    devices = Engine.probe_backend(
-        float(os.environ.get("BENCH_BACKEND_TIMEOUT", "300")))
+    devices = Engine.probe_backend()  # owns the BENCH_BACKEND_TIMEOUT knob
     n = len(devices)
     nproc = jax.process_count()
     if args.sizes:
