@@ -91,3 +91,39 @@ def test_cpu_platform_short_circuits(fresh_lock, monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     assert Engine.check_singleton() is True
     assert Engine._singleton_fd is None
+
+
+def test_probe_backend_paths(fresh_lock, monkeypatch):
+    import time
+
+    import jax
+
+    # normal path returns the device list
+    devs = Engine.probe_backend(timeout_s=60)
+    assert len(devs) >= 1
+
+    # a hanging backend raises within the bound instead of blocking
+    monkeypatch.setattr(jax, "devices", lambda *a: time.sleep(30))
+    with pytest.raises(RuntimeError, match="exceeded"):
+        Engine.probe_backend(timeout_s=0.2)
+
+    # a failing backend surfaces its error
+    def boom(*a):
+        raise ValueError("no backend")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    with pytest.raises(RuntimeError, match="no backend"):
+        Engine.probe_backend(timeout_s=5)
+
+    # second-driver conflict diagnosed as such, not as a timeout
+    monkeypatch.setenv("JAX_PLATFORMS", "faketpu")  # defeat cpu carve-out
+    holder = subprocess.Popen(
+        [sys.executable, "-c", HOLDER, Engine._singleton_lock_path()],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        with pytest.raises(RuntimeError, match="another process"):
+            Engine.probe_backend(timeout_s=5)
+    finally:
+        holder.kill()
+        holder.wait()
