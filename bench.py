@@ -115,6 +115,27 @@ def peak_flops_per_sec():
     return None
 
 
+def make_step(name: str, batch: int = None):
+    """Build the exact train step a config benches — the shared setup
+    recipe (seed, graph passes, SGD 0.9-momentum, bf16 compute) for
+    bench.run_config, tools/profile_bench.py, and tools/hlo_dump.py so
+    their runtime and compiler views stay views of the SAME program.
+    Returns (step, x, y)."""
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.nn.fuse import optimize_for_tpu
+    from bigdl_tpu.parallel.train_step import TrainStep
+    from bigdl_tpu.utils.rng import RNG
+
+    build_model, build_batch, criterion, default_batch = _configs()[name]
+    RNG.set_seed(0)
+    model = optimize_for_tpu(build_model())
+    step = TrainStep(model, criterion,
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+    x, y = build_batch(batch or default_batch)
+    return step, x, y
+
+
 def make_drain(step):
     """Value-fetch sync: a params-derived scalar forces every queued
     dispatch INCLUDING its optimizer updates (the loss alone only depends
@@ -126,18 +147,7 @@ def make_drain(step):
 
 
 def run_config(name, build_model, build_batch, criterion, batch, iters):
-    import bigdl_tpu.optim as optim
-    from bigdl_tpu.parallel.train_step import TrainStep
-    from bigdl_tpu.utils.rng import RNG
-
-    RNG.set_seed(0)
-    from bigdl_tpu.nn.fuse import optimize_for_tpu
-
-    model = optimize_for_tpu(build_model())
-    step = TrainStep(model, criterion,
-                     optim.SGD(learning_rate=0.01, momentum=0.9),
-                     compute_dtype=jnp.bfloat16)
-    x, y = build_batch(batch)
+    step, x, y = make_step(name, batch)
 
     # ALL timed iterations run inside ONE dispatch (lax.scan over the
     # step) — per-dispatch latency is a property of the host link, not of

@@ -39,9 +39,10 @@ CASES = [
      .split_ties(), (2, 3, 9, 9)),
     ("lrn_banded_conv", lambda: nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
      (2, 7, 5, 5)),
-    # shape/table plumbing with nontrivial transposes
-    ("roi_pooling_free", lambda: nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1,
-                                                          ceil_mode=True),
+    # ceil-mode average pooling (asymmetric declared-vs-overflow padding
+    # divisors — the subtle Torch semantics in _PoolBase._avg)
+    ("ceil_avg_pool", lambda: nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1,
+                                                       ceil_mode=True),
      (2, 3, 9, 9)),
 ]
 

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Dump the train-step HLO for a bench config — the compiler-side view
+that pairs with ``tools/profile_bench.py``'s runtime view.
+
+Prints either the unoptimized StableHLO/HLO (portable, default) or the
+backend-optimized HLO (``--optimized``, shows fusions/layouts the device
+actually runs), plus a quick op-kind histogram.  Used to chase where the
+compiler spends the step (e.g. the round-3 finding that maxpool backward
+lowered to 9 interior pads).
+
+Usage: python tools/hlo_dump.py [config] [--optimized] [--batch N]
+       [--grep PATTERN]
+"""
+
+import argparse
+import os
+import re
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", nargs="?", default="inception_v1_imagenet")
+    ap.add_argument("--optimized", action="store_true",
+                    help="backend-optimized HLO (after fusion/layout)")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="batch for shape purposes (default 8: tracing "
+                    "only, no execution)")
+    ap.add_argument("--grep", default=None,
+                    help="print only lines matching this regex")
+    ap.add_argument("--out", default=None, help="write full text here")
+    args = ap.parse_args()
+
+    import jax
+
+    import bench
+
+    step, x, y = bench.make_step(args.config, args.batch)
+    fn = jax.jit(step._step_fn())
+    lowered = fn.lower(step.params, step.opt_state, step.buffers, x, y,
+                       jax.random.key(0))
+    if args.optimized:
+        text = lowered.compile().as_text()
+    else:
+        text = lowered.as_text("hlo")
+
+    kinds = Counter()
+    for m in re.finditer(r"= \S+ (\w[\w-]*)\(", text):
+        kinds[m.group(1)] += 1
+    print(f"# {args.config}: {len(text.splitlines())} HLO lines; top ops:",
+          file=sys.stderr)
+    for k, n in kinds.most_common(15):
+        print(f"#   {k:30s} {n}", file=sys.stderr)
+
+    if args.grep:
+        pat = re.compile(args.grep)
+        text = "\n".join(l for l in text.splitlines() if pat.search(l))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"# {'filtered' if args.grep else 'full'} text -> {args.out}",
+              file=sys.stderr)
+    else:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()

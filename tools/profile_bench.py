@@ -21,20 +21,8 @@ def capture(config_name="inception_v1_imagenet", batch=None, iters=8,
             logdir="/tmp/jaxprof"):
     import bench
 
-    cfgs = bench._configs()
-    build_model, build_batch, criterion, b = cfgs[config_name]
-    if batch:
-        b = batch
-    import bigdl_tpu.optim as optim
-    from bigdl_tpu.parallel.train_step import TrainStep
-    from bigdl_tpu.utils.rng import RNG
-
-    RNG.set_seed(0)
-    model = build_model()
-    step = TrainStep(model, criterion,
-                     optim.SGD(learning_rate=0.01, momentum=0.9),
-                     compute_dtype=jnp.bfloat16)
-    x, y = build_batch(b)
+    # the SAME program bench times and hlo_dump prints (incl. graph passes)
+    step, x, y = bench.make_step(config_name, batch)
     step.aot_scan(x, y, jax.random.key(0), iters)
     # warmup
     step.run_scan(x, y, jax.random.key(1), iters)
