@@ -290,6 +290,28 @@ def test_lstm_matches_torch():
     _cmp(out, ref.detach().numpy(), rtol=1e-3, atol=1e-4)
 
 
+def test_lstm_backward_matches_torch():
+    """Input gradients THROUGH the lax.scan time loop vs torch's
+    unrolled backward."""
+    hidden, inp = 7, 5
+    cell = nn.LSTM(inp, hidden)
+    rec = nn.Recurrent(cell)
+    x_np = np.random.randn(3, 6, inp).astype(np.float32)
+    gy = np.random.randn(3, 6, hidden).astype(np.float32)
+
+    tl = torch.nn.LSTM(inp, hidden, batch_first=True)
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
+        tl.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
+        tl.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.h2g.weight)))
+        tl.bias_hh_l0.zero_()
+    gx = rec.backward(jnp.asarray(x_np), jnp.asarray(gy))
+    tx = torch.tensor(x_np, requires_grad=True)
+    out, _ = tl(tx)
+    out.backward(torch.tensor(gy))
+    _cmp(gx, tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
 def test_gru_matches_torch():
     hidden, inp = 4, 3
     cell = nn.GRU(inp, hidden)
