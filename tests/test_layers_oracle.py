@@ -329,6 +329,26 @@ def test_gru_matches_torch():
     _cmp(out, ref.detach().numpy(), rtol=1e-3, atol=1e-4)
 
 
+def test_gru_backward_matches_torch():
+    hidden, inp = 4, 3
+    cell = nn.GRU(inp, hidden)
+    rec = nn.Recurrent(cell)
+    x_np = np.random.randn(2, 5, inp).astype(np.float32)
+    gy = np.random.randn(2, 5, hidden).astype(np.float32)
+    tg = torch.nn.GRU(inp, hidden, batch_first=True)
+    with torch.no_grad():
+        tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
+        tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
+        w_hh = np.concatenate([np.asarray(cell.h2rz.weight), np.asarray(cell.h2n.weight)])
+        tg.weight_hh_l0.copy_(torch.tensor(w_hh))
+        tg.bias_hh_l0.zero_()
+    gx = rec.backward(jnp.asarray(x_np), jnp.asarray(gy))
+    tx = torch.tensor(x_np, requires_grad=True)
+    out, _ = tg(tx)
+    out.backward(torch.tensor(gy))
+    _cmp(gx, tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
 def test_rnn_cell_and_birecurrent_shapes():
     rec = nn.Recurrent(nn.RnnCell(4, 6))
     x = jnp.asarray(np.random.randn(2, 5, 4).astype(np.float32))
