@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bigdl_tpu.nn.graph import Graph, Node
 from bigdl_tpu.nn.layers.container_ext import Concat
 from bigdl_tpu.nn.layers.conv import SpatialConvolution
 from bigdl_tpu.nn.layers.normalization import SpatialBatchNormalization
@@ -44,23 +45,29 @@ def optimize_for_tpu(model: Module) -> Module:
     but when the model root itself is an eligible input conv,
     ``space_to_depth_input`` must return a new root.  (``fold_batchnorm``
     is inference-only and therefore NOT included here.)"""
-    merge_sibling_convs(model)
+    model = merge_sibling_convs(model)  # may REBUILD a Graph root
     return space_to_depth_input(model)
 
 
 def merge_sibling_convs(model: Module) -> Module:
-    """Merge runs of adjacent ``Concat`` branches that start with
-    same-signature convolutions (see module docstring).  In-place."""
-    _walk(model)
-    return model
+    """Merge same-signature sibling convolutions over a shared input —
+    both forms of the Inception pattern: adjacent ``Concat`` branches
+    (container models) and same-predecessor fan-out nodes (``Graph``
+    DAGs, i.e. Caffe/TF-imported models).  Mostly in place, but a Graph
+    root is rebuilt — ALWAYS rebind the result."""
+    return _walk(model)
 
 
-def _walk(m: Module) -> None:
+def _walk(m: Module) -> Module:
+    if isinstance(m, Graph):
+        return _merge_graph_siblings(m)
     if isinstance(m, Container):
-        for child in m.layers:
-            _walk(child)
+        mods = m.__dict__["_modules"]
+        for k in list(mods):
+            mods[k] = _walk(mods[k])
         if isinstance(m, Concat):
             _merge_concat(m)
+    return m
 
 
 def _leading_conv(branch: Module) -> Optional[Tuple[SpatialConvolution, List[Module]]]:
@@ -89,21 +96,95 @@ def _signature(conv: SpatialConvolution):
             conv.with_bias, conv.format, conv.propagate_back)
 
 
-def _merge_run(dim: int, entries) -> Module:
-    """One branch replacing a run of (conv, rest) branches: the merged
-    conv followed by an inner Concat of Narrow-sliced remainders."""
-    convs = [c for c, _ in entries]
+def _merged_conv_of(convs) -> SpatialConvolution:
+    """One conv whose output channels are the concatenation of the
+    siblings' (identical signatures assumed)."""
     c0 = convs[0]
     w = jnp.concatenate([c.weight for c in convs], axis=0)
     b = jnp.concatenate([c.bias for c in convs], axis=0) \
         if c0.with_bias else None
-    total = sum(c.n_output_plane for c in convs)
     merged = SpatialConvolution(
-        c0.n_input_plane, total, c0.kernel_w, c0.kernel_h,
-        c0.stride_w, c0.stride_h, c0.pad_w, c0.pad_h,
-        propagate_back=c0.propagate_back, init_weight=w, init_bias=b,
-        with_bias=c0.with_bias, format=c0.format)
+        c0.n_input_plane, sum(c.n_output_plane for c in convs),
+        c0.kernel_w, c0.kernel_h, c0.stride_w, c0.stride_h,
+        c0.pad_w, c0.pad_h, propagate_back=c0.propagate_back,
+        init_weight=w, init_bias=b, with_bias=c0.with_bias,
+        format=c0.format)
     merged.set_name("+".join(c.get_name() for c in convs))
+    return merged
+
+
+def _merge_graph_siblings(g: Graph) -> Graph:
+    """Graph form of the sibling merge: nodes wrapping same-signature
+    convs that consume the SAME predecessor output fan out into one
+    merged conv node, and each original node's element becomes a
+    ``Narrow`` channel slice — downstream edges stay untouched, so the
+    rewrite composes with arbitrary imported DAGs (Caffe GoogLeNet, TF
+    GraphDefs)."""
+    # negative axes so slices work for batched (NCHW) AND the conv's
+    # supported unbatched (CHW) inputs alike
+    c_axis = {"NCHW": -3, "NHWC": -1}
+    changed = False
+    # recurse into node elements first (a node may wrap a Sequential
+    # containing Concats — or a whole inner Graph that gets REBUILT)
+    for n in g._sorted:
+        new_el = _walk(n.element)
+        if new_el is not n.element:
+            n.element = new_el
+            changed = True  # _modules must re-register the new object
+
+    # a module object wrapped by MORE than one node is weight-shared
+    # (Siamese); repacking any of its uses would fork the tied weights
+    uses: dict = {}
+    for n in g._sorted:
+        uses[id(n.element)] = uses.get(id(n.element), 0) + 1
+
+    groups: dict = {}
+    for n in g._sorted:
+        el = n.element
+        if type(el) is not SpatialConvolution or len(n.prev) != 1:
+            continue
+        if uses[id(el)] > 1:
+            continue
+        name = el.__dict__["_name"]
+        if name and name in g._stop_gradient:
+            continue
+        if _leading_conv(el) is None:
+            continue
+        p, idx = n.prev[0]
+        groups.setdefault((p.id, idx, _signature(el)), (p, idx, []))[2] \
+            .append(n)
+
+    for (pid, _i, _sig), (pnode, idx, nodes) in groups.items():
+        if len(nodes) < 2:
+            continue
+        convs = [n.element for n in nodes]
+        merged = _merged_conv_of(convs)
+        mnode = Node(merged)
+        mnode.add_prev(pnode, idx)
+        dim = c_axis[convs[0].format]
+        offset = 0
+        for n in nodes:
+            pnode.next.remove(n)
+            n.prev = []
+            narrow = Narrow(dim, offset, n.element.n_output_plane)
+            narrow.set_name((n.element.get_name() or "conv") + "/slice")
+            offset += n.element.n_output_plane
+            n.element = narrow
+            n.add_prev(mnode)
+        changed = True
+
+    if not changed:
+        return g
+    rebuilt = Graph(g.input_nodes, g.output_nodes)
+    rebuilt._stop_gradient = set(g._stop_gradient)
+    return rebuilt
+
+
+def _merge_run(dim: int, entries) -> Module:
+    """One branch replacing a run of (conv, rest) branches: the merged
+    conv followed by an inner Concat of Narrow-sliced remainders."""
+    convs = [c for c, _ in entries]
+    merged = _merged_conv_of(convs)
     inner = Concat(dim)
     offset = 0
     for conv, rest in entries:

@@ -168,6 +168,152 @@ def test_fold_batchnorm_biasless_conv():
     np.testing.assert_allclose(_forward(model, x), ref, rtol=1e-4, atol=1e-5)
 
 
+def test_graph_sibling_merge_exact():
+    """The DAG form (imported models): same-input fan-out convs merge
+    into one node; consumers see Narrow slices."""
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    RNG.set_seed(11)
+    def build():
+        inp = Input(name="in")
+        b1 = nn.SpatialConvolution(16, 8, 1, 1).set_name("b1").inputs(inp)
+        b2 = nn.SpatialConvolution(16, 12, 1, 1).set_name("b2").inputs(inp)
+        b2b = nn.SpatialConvolution(12, 24, 3, 3, 1, 1, 1, 1)\
+            .set_name("b2b").inputs(nn.ReLU(True).inputs(b2))
+        b3 = nn.SpatialConvolution(16, 4, 1, 1).set_name("b3").inputs(inp)
+        pool = nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).inputs(inp)
+        join = nn.JoinTable(1).inputs(b1, b2b, b3, pool)
+        return Graph(inp, join)
+
+    x = np.random.randn(2, 16, 7, 7).astype(np.float32)
+    RNG.set_seed(11)
+    ref = _forward(build(), x)
+    RNG.set_seed(11)
+    fused = merge_sibling_convs(build())
+    np.testing.assert_allclose(_forward(fused, x), ref, rtol=1e-5, atol=1e-6)
+    # the three same-input 1x1 convs became ONE conv node
+    n_convs = sum(1 for m in fused.layers
+                  if isinstance(m, nn.SpatialConvolution))
+    assert n_convs == 2  # merged(8+12+4) + b2b
+    # gradients flow through the rewritten DAG
+    gy = np.random.randn(2, 8 + 24 + 4 + 16, 7, 7).astype(np.float32)
+    g = fused.backward(jnp.asarray(x), jnp.asarray(gy))
+    assert np.asarray(g).shape == x.shape
+
+
+def test_optimize_for_tpu_returns_rebuilt_graph():
+    """optimize_for_tpu must propagate merge_sibling_convs' REBUILT
+    Graph — returning the surgically-mutated original (stale topo order)
+    produced a KeyError at forward time."""
+    from bigdl_tpu.nn.fuse import optimize_for_tpu
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    RNG.set_seed(13)
+    inp = Input(name="in")
+    a = nn.SpatialConvolution(6, 4, 1, 1).inputs(inp)
+    b = nn.SpatialConvolution(6, 5, 1, 1).inputs(inp)
+    join = nn.JoinTable(1).inputs(a, b)
+    g = Graph(inp, join)
+    x = np.random.randn(2, 6, 5, 5).astype(np.float32)
+    ref = _forward(g, x)
+    opt = optimize_for_tpu(g)
+    assert opt is not g  # rebuilt root
+    np.testing.assert_allclose(_forward(opt, x), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_graph_merge_unbatched_input():
+    """SpatialConvolution supports unbatched CHW inputs; the Narrow
+    slices must too (negative channel axis)."""
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    RNG.set_seed(14)
+    def build():
+        inp = Input(name="in")
+        a = nn.SpatialConvolution(6, 4, 1, 1).inputs(inp)
+        b = nn.SpatialConvolution(6, 5, 1, 1).inputs(inp)
+        return Graph(inp, nn.JoinTable(0).inputs(a, b))
+
+    x3 = np.random.randn(6, 5, 5).astype(np.float32)  # CHW, no batch
+    RNG.set_seed(14)
+    ref = _forward(build(), x3)
+    RNG.set_seed(14)
+    fused = merge_sibling_convs(build())
+    np.testing.assert_allclose(_forward(fused, x3), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_graph_merge_inner_graph_reregistered():
+    """An inner Graph rebuilt by the recursion must be re-registered in
+    the outer Graph's module table, or training/state_dict would keep the
+    dead pre-merge weights."""
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input
+    from bigdl_tpu.nn.module import state_dict
+
+    RNG.set_seed(15)
+    i_in = Input(name="i")
+    ia = nn.SpatialConvolution(4, 3, 1, 1).inputs(i_in)
+    ib = nn.SpatialConvolution(4, 2, 1, 1).inputs(i_in)
+    inner = Graph(i_in, nn.JoinTable(1).inputs(ia, ib))
+
+    o_in = Input(name="o")
+    wrapped = inner.inputs(o_in)
+    outer = Graph(o_in, nn.ReLU(True).inputs(wrapped))
+
+    x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+    ref = _forward(outer, x)
+    fused = merge_sibling_convs(outer)
+    np.testing.assert_allclose(_forward(fused, x), ref, rtol=1e-5, atol=1e-6)
+    # the LIVE merged conv's parameters are discoverable for training
+    shapes = [tuple(v.shape) for v in state_dict(fused, kind="param").values()]
+    assert (5, 4, 1, 1) in shapes, shapes  # merged 3+2 output channels
+
+
+def test_graph_merge_skips_cross_group_weight_sharing():
+    """A conv module wrapped by nodes in DIFFERENT groups (Siamese) must
+    not be repacked — merging would fork the tied weights."""
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input, Node
+
+    RNG.set_seed(16)
+    in1, in2 = Input(name="x1"), Input(name="x2")
+    shared = nn.SpatialConvolution(4, 4, 1, 1)
+    n1, n2 = Node(shared), Node(shared)
+    n1.add_prev(in1)
+    n2.add_prev(in2)
+    other = nn.SpatialConvolution(4, 6, 1, 1).inputs(in1)  # same input as n1
+    join = nn.JoinTable(1).inputs(n1, n2, other)
+    g = Graph([in1, in2], join)
+    xs = [jnp.asarray(np.random.randn(2, 4, 5, 5).astype(np.float32))
+          for _ in range(2)]
+    ref = np.asarray(g.forward(xs))
+    fused = merge_sibling_convs(g)
+    np.testing.assert_array_equal(np.asarray(fused.forward(xs)), ref)
+    # the shared conv is still ONE object wherever it appears
+    convs = [m for m in fused.layers if isinstance(m, nn.SpatialConvolution)]
+    assert sum(1 for c in convs if c is shared) >= 1
+
+
+def test_graph_merge_skips_weight_shared_clones():
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input, Node
+
+    RNG.set_seed(12)
+    inp = Input(name="in")
+    conv = nn.SpatialConvolution(4, 4, 1, 1)
+    n1, n2 = Node(conv), Node(conv)  # same module object twice
+    n1.add_prev(inp)
+    n2.add_prev(inp)
+    join = nn.JoinTable(1).inputs(n1, n2)
+    g = Graph(inp, join)
+    x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+    ref = _forward(g, x)
+    fused = merge_sibling_convs(g)
+    np.testing.assert_array_equal(_forward(fused, x), ref)
+
+
 @pytest.mark.parametrize("h,w,k,s,p", [
     (224, 224, 7, 2, 3),   # the ImageNet conv1 shape
     (11, 11, 2, 2, 0),     # trailing row cropped (negative hi pad)
