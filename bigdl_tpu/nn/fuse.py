@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from bigdl_tpu.nn.graph import Graph, Node
@@ -113,6 +114,26 @@ def _merged_conv_of(convs) -> SpatialConvolution:
     return merged
 
 
+def _element_use_counts(g: Graph) -> dict:
+    """id(element) -> number of nodes wrapping it (weight sharing)."""
+    uses: dict = {}
+    for n in g._sorted:
+        uses[id(n.element)] = uses.get(id(n.element), 0) + 1
+    return uses
+
+
+def _rebuild_graph(g: Graph) -> Graph:
+    """Fresh Graph over the (surgically modified) node structure,
+    preserving graph-level state: stop-gradient set, name, train flag."""
+    rebuilt = Graph(g.input_nodes, g.output_nodes)
+    rebuilt._stop_gradient = set(g._stop_gradient)
+    if g.__dict__.get("_name"):
+        rebuilt.set_name(g.__dict__["_name"])
+    if not g.training:
+        rebuilt.evaluate()
+    return rebuilt
+
+
 def _merge_graph_siblings(g: Graph) -> Graph:
     """Graph form of the sibling merge: nodes wrapping same-signature
     convs that consume the SAME predecessor output fan out into one
@@ -125,18 +146,22 @@ def _merge_graph_siblings(g: Graph) -> Graph:
     c_axis = {"NCHW": -3, "NHWC": -1}
     changed = False
     # recurse into node elements first (a node may wrap a Sequential
-    # containing Concats — or a whole inner Graph that gets REBUILT)
+    # containing Concats — or a whole inner Graph that gets REBUILT).
+    # Each DISTINCT element is walked once: a shared (Siamese) inner
+    # graph must map to ONE rebuilt object, not a rebuilt copy for the
+    # first node and a stale mutated original for the rest.
+    walked: dict = {}
     for n in g._sorted:
-        new_el = _walk(n.element)
-        if new_el is not n.element:
-            n.element = new_el
+        key = id(n.element)
+        if key not in walked:
+            walked[key] = _walk(n.element)
+        if walked[key] is not n.element:
+            n.element = walked[key]
             changed = True  # _modules must re-register the new object
 
     # a module object wrapped by MORE than one node is weight-shared
     # (Siamese); repacking any of its uses would fork the tied weights
-    uses: dict = {}
-    for n in g._sorted:
-        uses[id(n.element)] = uses.get(id(n.element), 0) + 1
+    uses = _element_use_counts(g)
 
     groups: dict = {}
     for n in g._sorted:
@@ -175,9 +200,7 @@ def _merge_graph_siblings(g: Graph) -> Graph:
 
     if not changed:
         return g
-    rebuilt = Graph(g.input_nodes, g.output_nodes)
-    rebuilt._stop_gradient = set(g._stop_gradient)
-    return rebuilt
+    return _rebuild_graph(g)
 
 
 def _merge_run(dim: int, entries) -> Module:
@@ -311,60 +334,85 @@ def space_to_depth_input(model: Module) -> Module:
     is exact — forward, gradients, and the whole SGD trajectory — up to
     float reassociation.  In place where possible; call as
     ``model = space_to_depth_input(model)``."""
-    import numpy as np
+    if isinstance(model, Graph):
+        return _s2d_graph_inputs(model)
 
-    def repack(conv: SpatialConvolution) -> Sequential:
-        s_h, s_w = conv.stride_h, conv.stride_w
-        k_h, k_w = conv.kernel_h, conv.kernel_w
-        kp_h, kp_w = -(-k_h // s_h), -(-k_w // s_w)
-        c_in, c_out = conv.n_input_plane, conv.n_output_plane
-        w = np.asarray(conv.weight)
-        wp = np.zeros((c_out, c_in * s_h * s_w, kp_h, kp_w), w.dtype)
-        mask = np.zeros((1, c_in * s_h * s_w, kp_h, kp_w), np.float32)
-        for a_h in range(s_h):
-            for a_w in range(s_w):
-                for j_h in range(kp_h):
-                    dy = s_h * j_h + a_h
-                    if dy >= k_h:
-                        continue
-                    for j_w in range(kp_w):
-                        dx = s_w * j_w + a_w
-                        if dx >= k_w:
-                            continue
-                        ch = (np.arange(c_in) * s_h + a_h) * s_w + a_w
-                        wp[:, ch, j_h, j_w] = w[:, :, dy, dx]
-                        mask[:, ch, j_h, j_w] = 1.0
-        new_conv = _MaskedStride1Conv(
-            c_in * s_h * s_w, c_out, kp_w, kp_h,
-            propagate_back=conv.propagate_back,
-            init_weight=jnp.asarray(wp),
-            init_bias=conv.bias if conv.with_bias else None,
-            with_bias=conv.with_bias)
-        new_conv.register_buffer("weight_mask", jnp.asarray(mask))
-        new_conv.set_name(conv.get_name() + "/s2d")
-        return Sequential(
-            _SpaceToDepthPad(s_h, s_w, conv.pad_h, conv.pad_w, k_h, k_w),
-            new_conv)
-
-    def eligible(m: Module) -> bool:
-        return (type(m) is SpatialConvolution and m.format == "NCHW"
-                and m.n_group == 1 and m.n_input_plane <= 4
-                and (m.stride_h > 1 or m.stride_w > 1)
-                and m.pad_h >= 0 and m.pad_w >= 0  # -1 = SAME: different math
-                and _leading_conv(m) is not None)
-
-    # the input conv is the first leaf on the input path: descend through
-    # leading Sequentials
-    if eligible(model):
-        return repack(model)
+    if _s2d_eligible(model):
+        return _s2d_repack(model)
     m = model
     while type(m) is Sequential and len(m) > 0:
         first = m.get(0)
-        if eligible(first):
-            m.__dict__["_modules"]["0"] = repack(first)
+        if _s2d_eligible(first):
+            m.__dict__["_modules"]["0"] = _s2d_repack(first)
             return model
         m = first
     return model
+
+
+def _s2d_repack(conv: SpatialConvolution) -> Sequential:
+    s_h, s_w = conv.stride_h, conv.stride_w
+    k_h, k_w = conv.kernel_h, conv.kernel_w
+    kp_h, kp_w = -(-k_h // s_h), -(-k_w // s_w)
+    c_in, c_out = conv.n_input_plane, conv.n_output_plane
+    w = np.asarray(conv.weight)
+    wp = np.zeros((c_out, c_in * s_h * s_w, kp_h, kp_w), w.dtype)
+    mask = np.zeros((1, c_in * s_h * s_w, kp_h, kp_w), np.float32)
+    for a_h in range(s_h):
+        for a_w in range(s_w):
+            for j_h in range(kp_h):
+                dy = s_h * j_h + a_h
+                if dy >= k_h:
+                    continue
+                for j_w in range(kp_w):
+                    dx = s_w * j_w + a_w
+                    if dx >= k_w:
+                        continue
+                    ch = (np.arange(c_in) * s_h + a_h) * s_w + a_w
+                    wp[:, ch, j_h, j_w] = w[:, :, dy, dx]
+                    mask[:, ch, j_h, j_w] = 1.0
+    new_conv = _MaskedStride1Conv(
+        c_in * s_h * s_w, c_out, kp_w, kp_h,
+        propagate_back=conv.propagate_back,
+        init_weight=jnp.asarray(wp),
+        init_bias=conv.bias if conv.with_bias else None,
+        with_bias=conv.with_bias)
+    new_conv.register_buffer("weight_mask", jnp.asarray(mask))
+    new_conv.set_name(conv.get_name() + "/s2d")
+    return Sequential(
+        _SpaceToDepthPad(s_h, s_w, conv.pad_h, conv.pad_w, k_h, k_w),
+        new_conv)
+
+def _s2d_eligible(m: Module) -> bool:
+    return (type(m) is SpatialConvolution and m.format == "NCHW"
+            and m.n_group == 1 and m.n_input_plane <= 4
+            and (m.stride_h > 1 or m.stride_w > 1)
+            and m.pad_h >= 0 and m.pad_w >= 0  # -1 = SAME: different math
+            and _leading_conv(m) is not None)
+
+
+def _s2d_graph_inputs(g: Graph) -> Graph:
+    """Graph form: repack eligible conv nodes fed DIRECTLY by an input
+    node (the imported-model conv1 pattern).  The node's element becomes
+    the pad+conv Sequential; edges stay untouched, but a NEW Graph root
+    is returned when anything changed so the module table re-registers
+    the swapped elements — rebind the result."""
+    input_ids = {n.id for n in g.input_nodes}
+    changed = False
+    uses = _element_use_counts(g)
+    for n in g._sorted:
+        el = n.element
+        if not _s2d_eligible(el) or uses[id(el)] > 1:
+            continue
+        if len(n.prev) != 1 or n.prev[0][0].id not in input_ids:
+            continue
+        name = el.__dict__["_name"]
+        if name and name in g._stop_gradient:
+            continue
+        n.element = _s2d_repack(el)
+        changed = True
+    if not changed:
+        return g
+    return _rebuild_graph(g)
 
 
 def fold_batchnorm(model: Module) -> Module:

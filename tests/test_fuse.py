@@ -271,6 +271,50 @@ def test_graph_merge_inner_graph_reregistered():
     assert (5, 4, 1, 1) in shapes, shapes  # merged 3+2 output channels
 
 
+def test_graph_merge_shared_inner_graph():
+    """A Siamese inner Graph wrapped by TWO nodes must map to ONE
+    rebuilt object — not a rebuilt copy for the first node and a stale
+    mutated original (with a dangling merged node) for the second."""
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input, Node
+
+    RNG.set_seed(18)
+    i_in = Input(name="i")
+    ia = nn.SpatialConvolution(4, 3, 1, 1).inputs(i_in)
+    ib = nn.SpatialConvolution(4, 2, 1, 1).inputs(i_in)
+    inner = Graph(i_in, nn.JoinTable(1).inputs(ia, ib))
+
+    o1, o2 = Input(name="x1"), Input(name="x2")
+    n1, n2 = Node(inner), Node(inner)  # shared tower
+    n1.add_prev(o1)
+    n2.add_prev(o2)
+    join = nn.JoinTable(1).inputs(n1, n2)
+    outer = Graph([o1, o2], join)
+
+    xs = [jnp.asarray(np.random.randn(2, 4, 5, 5).astype(np.float32))
+          for _ in range(2)]
+    ref = np.asarray(outer.forward(xs))
+    fused = merge_sibling_convs(outer)
+    np.testing.assert_allclose(np.asarray(fused.forward(xs)), ref,
+                               rtol=1e-5, atol=1e-6)
+    assert n1.element is n2.element  # still ONE shared tower
+
+
+def test_graph_rebuild_preserves_name_and_eval_mode():
+    from bigdl_tpu.nn.fuse import merge_sibling_convs
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    RNG.set_seed(19)
+    inp = Input(name="in")
+    a = nn.SpatialConvolution(4, 3, 1, 1).inputs(inp)
+    b = nn.SpatialConvolution(4, 2, 1, 1).inputs(inp)
+    g = Graph(inp, nn.JoinTable(1).inputs(a, b)).set_name("backbone")
+    g.evaluate()
+    fused = merge_sibling_convs(g)
+    assert fused.get_name() == "backbone"
+    assert not fused.is_training()
+
+
 def test_graph_merge_skips_cross_group_weight_sharing():
     """A conv module wrapped by nodes in DIFFERENT groups (Siamese) must
     not be repacked — merging would fork the tied weights."""
@@ -364,6 +408,31 @@ def test_space_to_depth_input_exact(h, w, k, s, p):
                     np.testing.assert_allclose(
                         gw[:, ch, j_h, j_w], g_ref[:, :, dy, dx],
                         rtol=1e-4, atol=1e-5)
+
+
+def test_space_to_depth_on_graph_input_conv():
+    """Imported DAGs: the conv1 node fed by an Input gets the s2d repack
+    (element swapped for the pad+masked-conv Sequential)."""
+    from bigdl_tpu.nn.fuse import optimize_for_tpu
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    RNG.set_seed(17)
+    def build():
+        inp = Input(name="in")
+        c1 = nn.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3).inputs(inp)
+        r = nn.ReLU(True).inputs(c1)
+        deep = nn.SpatialConvolution(8, 6, 3, 3, 2, 2, 1, 1).inputs(r)
+        return Graph(inp, deep)
+
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    RNG.set_seed(17)
+    ref = _forward(build(), x)
+    RNG.set_seed(17)
+    opt = optimize_for_tpu(build())
+    np.testing.assert_allclose(_forward(opt, x), ref, rtol=1e-5, atol=1e-6)
+    # conv1 repacked, deep conv (8 channels) untouched
+    kinds = [type(m).__name__ for m in opt.layers]
+    assert "Sequential" in kinds and kinds.count("SpatialConvolution") == 1
 
 
 def test_space_to_depth_skips_wide_input_convs():
