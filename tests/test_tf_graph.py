@@ -307,3 +307,33 @@ def test_import_resize_bilinear_half_pixel_vs_tf():
     model = load_graphdef(gd, ["x"], ["out"])
     got = np.asarray(model.forward(jnp.asarray(x_np)))
     np.testing.assert_allclose(got, golden["out:0"], rtol=1e-4, atol=1e-5)
+
+
+def test_imported_tf_graph_optimize_is_safe_noop():
+    """TF imports keep TF-op fidelity: Conv2D takes its weight as a graph
+    INPUT (separate Const/Variable node, separate BiasAdd), so the
+    sibling merge — which repacks SpatialConvolution-owned weights — does
+    not apply.  optimize_for_tpu must pass such graphs through unchanged
+    rather than corrupt them.  (Caffe imports DO get the fusion: their
+    loader builds SpatialConvolution nodes — see test_fuse.py.)"""
+    from bigdl_tpu.nn.fuse import optimize_for_tpu
+
+    rng = np.random.RandomState(3)
+    wa = rng.randn(1, 1, 4, 3).astype(np.float32)  # HWIO
+    wb = rng.randn(1, 1, 4, 5).astype(np.float32)
+    strides = _attr("strides", pw.emit_bytes(
+        1, b"".join(pw.emit_varint(3, i) for i in (1, 1, 1, 1))))
+    pad = _attr("padding", pw.emit_bytes(2, b"VALID"))
+    gd = b""
+    gd += _node("x", "Placeholder", ())
+    gd += _const("wa", wa)
+    gd += _const("wb", wb)
+    gd += _node("ca", "Conv2D", ("x", "wa"), pad + strides)
+    gd += _node("cb", "Conv2D", ("x", "wb"), pad + strides)
+    gd += _const("axis", np.asarray(3, np.int32), _DT_INT32)
+    gd += _node("cat", "ConcatV2", ("ca", "cb", "axis"))
+    model = load_graphdef(gd, ["x"], ["cat"]).evaluate()
+    x = rng.randn(2, 6, 6, 4).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    opt = optimize_for_tpu(model)
+    np.testing.assert_array_equal(np.asarray(opt.forward(x)), ref)
