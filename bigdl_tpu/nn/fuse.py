@@ -198,9 +198,92 @@ def _merge_graph_siblings(g: Graph) -> Graph:
             n.add_prev(mnode)
         changed = True
 
+    changed = _merge_tf_conv_siblings(g, uses) or changed
+
     if not changed:
         return g
     return _rebuild_graph(g)
+
+
+def _merge_tf_conv_siblings(g: Graph, uses: dict) -> bool:
+    """TF-op form (``ops.Conv2D`` takes its HWIO weight as a SECOND graph
+    input from a Const/Variable node): same-attr sibling convs over one
+    data input merge by concatenating their weight nodes on the O axis.
+    BiasAdd consumers are untouched — they read the Narrow slices.
+    Orphaned weight nodes fall out of the rebuilt topo order."""
+    from bigdl_tpu.nn import ops as nnops
+    from bigdl_tpu.nn import tf as nntf
+
+    def weight_of(wnode) -> Optional[jnp.ndarray]:
+        el = wnode.element
+        name = el.__dict__.get("_name")
+        if name and name in g._stop_gradient:
+            return None  # frozen-by-name weight must not be repacked
+        if type(el) is nntf.Const:
+            return el.value
+        d = el.__dict__
+        if type(el) is nntf.Variable and not d.get("_frozen") \
+                and d.get("scale_w", 1.0) == 1.0 \
+                and d.get("w_regularizer") is None:
+            return el.weight
+        return None
+
+    groups: dict = {}
+    for n in g._sorted:
+        el = n.element
+        if type(el) is not nnops.Conv2D or len(n.prev) != 2:
+            continue
+        if uses[id(el)] > 1:
+            continue
+        name = el.__dict__["_name"]
+        if name and name in g._stop_gradient:
+            continue
+        (dnode, didx), (wnode, widx) = n.prev
+        if widx is not None or len(wnode.next) != 1 \
+                or uses.get(id(wnode.element), 1) > 1:
+            continue
+        w = weight_of(wnode)
+        if w is None or w.ndim != 4:
+            continue
+        sig = (el.strides, el.padding, el.format, el.dilation,
+               tuple(w.shape[:3]), str(w.dtype),
+               type(wnode.element).__name__)
+        groups.setdefault((dnode.id, didx, sig), (dnode, didx, []))[2] \
+            .append((n, wnode, w))
+
+    changed = False
+    for (_pid, _i, sig), (dnode, didx, members) in groups.items():
+        if len(members) < 2:
+            continue
+        w_merged = jnp.concatenate([w for _, _, w in members], axis=3)
+        wcls = type(members[0][1].element)
+        merged_w = wcls(w_merged)
+        merged_w.set_name("+".join(
+            wn.element.get_name() or "w" for _, wn, _ in members))
+        wnode_m = Node(merged_w)
+        conv0 = members[0][0].element
+        merged_conv = nnops.Conv2D(
+            conv0.strides[0], conv0.strides[1], conv0.padding,
+            conv0.format, conv0.dilation[0], conv0.dilation[1])
+        merged_conv.set_name("+".join(
+            n.element.get_name() or "conv" for n, _, _ in members))
+        mnode = Node(merged_conv)
+        mnode.add_prev(dnode, didx)
+        mnode.add_prev(wnode_m)
+        dim = -1 if conv0.format == "NHWC" else -3
+        offset = 0
+        for n, wnode, w in members:
+            dnode.next.remove(n)
+            wnode.next.remove(n)
+            n.prev = []
+            cout = int(w.shape[3])
+            narrow = Narrow(dim, offset, cout)
+            narrow.set_name((n.element.get_name() or "conv") + "/slice")
+            offset += cout
+            n.element = narrow
+            n.add_prev(mnode)
+        changed = True
+    return changed
 
 
 def _merge_run(dim: int, entries) -> Module:

@@ -309,13 +309,11 @@ def test_import_resize_bilinear_half_pixel_vs_tf():
     np.testing.assert_allclose(got, golden["out:0"], rtol=1e-4, atol=1e-5)
 
 
-def test_imported_tf_graph_optimize_is_safe_noop():
-    """TF imports keep TF-op fidelity: Conv2D takes its weight as a graph
-    INPUT (separate Const/Variable node, separate BiasAdd), so the
-    sibling merge — which repacks SpatialConvolution-owned weights — does
-    not apply.  optimize_for_tpu must pass such graphs through unchanged
-    rather than corrupt them.  (Caffe imports DO get the fusion: their
-    loader builds SpatialConvolution nodes — see test_fuse.py.)"""
+def test_imported_tf_graph_gets_sibling_merge():
+    """TF imports keep TF-op fidelity (Conv2D takes its HWIO weight as a
+    graph input from a Const/Variable node) — the merge concatenates the
+    WEIGHT NODES on the O axis and slices with Narrow, for both frozen
+    (Const) and trainable (train_consts=True -> Variable) imports."""
     from bigdl_tpu.nn.fuse import optimize_for_tpu
 
     rng = np.random.RandomState(3)
@@ -332,8 +330,25 @@ def test_imported_tf_graph_optimize_is_safe_noop():
     gd += _node("cb", "Conv2D", ("x", "wb"), pad + strides)
     gd += _const("axis", np.asarray(3, np.int32), _DT_INT32)
     gd += _node("cat", "ConcatV2", ("ca", "cb", "axis"))
-    model = load_graphdef(gd, ["x"], ["cat"]).evaluate()
-    x = rng.randn(2, 6, 6, 4).astype(np.float32)
-    ref = np.asarray(model.forward(x))
-    opt = optimize_for_tpu(model)
-    np.testing.assert_array_equal(np.asarray(opt.forward(x)), ref)
+    from bigdl_tpu.nn import ops as nnops
+    from bigdl_tpu.nn import tf as nntf
+    from bigdl_tpu.nn.module import state_dict
+
+    x = np.random.RandomState(4).randn(2, 6, 6, 4).astype(np.float32)
+    for trainable in (False, True):
+        model = load_graphdef(gd, ["x"], ["cat"],
+                              train_consts=trainable).evaluate()
+        ref = np.asarray(model.forward(x))
+        opt = optimize_for_tpu(model)
+        np.testing.assert_allclose(np.asarray(opt.forward(x)), ref,
+                                   rtol=1e-5, atol=1e-6)
+        convs = [m for m in opt.layers if isinstance(m, nnops.Conv2D)]
+        assert len(convs) == 1  # ca+cb merged
+        wcls = nntf.Variable if trainable else nntf.Const
+        merged_w = [m for m in opt.layers if isinstance(m, wcls)
+                    and getattr(m, "weight" if trainable else "value").shape[-1] == 8]
+        assert merged_w, "merged HWIO weight node missing"
+        if trainable:
+            shapes = [tuple(v.shape)
+                      for v in state_dict(opt, kind="param").values()]
+            assert (1, 1, 4, 8) in shapes  # ONE trainable merged weight
