@@ -129,9 +129,28 @@ class MultiHeadAttention(Module):
                                  "(q, k, v, mask)")
         else:
             xq = xk = xv = input
-        q = self._split(self.q_proj.forward(xq))
-        k = self._split(self.k_proj.forward(xk))
-        v = self._split(self.v_proj.forward(xv))
+        if xq is xk and xk is xv:
+            # self-attention: ONE [*, E] @ [E, 3E] GEMM instead of three
+            # [*, E] @ [E, E] with the same left operand — better MXU
+            # tiling. The weight concat is tiny next to the activation
+            # matmul; gradients flow through it back to the separate
+            # q/k/v parameters, so state_dict layout is unchanged.
+            # Deliberate tradeoff: this bypasses Linear.forward, so
+            # get_times() attributes the fused GEMM to THIS module, not
+            # per-projection.
+            w = jnp.concatenate([self.q_proj.weight, self.k_proj.weight,
+                                 self.v_proj.weight], axis=0)
+            qkv = jnp.dot(xq, w.T.astype(xq.dtype))
+            if self.q_proj.with_bias:
+                b_all = jnp.concatenate([self.q_proj.bias, self.k_proj.bias,
+                                         self.v_proj.bias])
+                qkv = qkv + b_all.astype(qkv.dtype)
+            q, k, v = (self._split(t)
+                       for t in jnp.split(qkv, 3, axis=-1))
+        else:
+            q = self._split(self.q_proj.forward(xq))
+            k = self._split(self.k_proj.forward(xk))
+            v = self._split(self.v_proj.forward(xv))
         out = self._attend(q, k, v, mask)
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)

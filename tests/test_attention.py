@@ -190,3 +190,29 @@ def test_mha_mask_and_dropout():
     e1 = mhad.forward(x)
     e2 = mhad.forward(x)
     np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+
+
+def test_fused_qkv_matches_separate_projections():
+    """Self-attention takes the fused [E,3E] projection path; feeding the
+    same VALUES as distinct (q, k, v) objects takes the separate-GEMM
+    path — both must agree, and the fused path's gradients must land in
+    the separate q/k/v parameters."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(21)
+    mha = nn.MultiHeadAttention(24, 4, causal=True).evaluate()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 24)
+                    .astype(np.float32))
+    fused = np.asarray(mha.forward(x))
+    apart = np.asarray(mha.forward((x, x + 0.0, x + 0.0)))
+    np.testing.assert_allclose(fused, apart, rtol=1e-5, atol=1e-6)
+
+    mha.training_mode()
+    mha.zero_grad_parameters()
+    gy = jnp.asarray(np.random.RandomState(1).randn(2, 6, 24)
+                     .astype(np.float32))
+    mha.backward(x, gy)
+    for proj in (mha.q_proj, mha.k_proj, mha.v_proj):
+        g = np.asarray(proj._grads["weight"])
+        assert np.abs(g).max() > 0, "fused path left a projection gradient-free"
