@@ -47,3 +47,19 @@ def test_explicit_override_wins(monkeypatch):
     finally:
         set_config(None)
     assert get_config().failure_retry_times == 2
+
+
+def test_bench_make_step_applies_graph_passes():
+    """The shared perf-tool recipe (bench.make_step) must bench the
+    graph-OPTIMIZED model — tools drifting onto the unfused model is how
+    the round-3 profile/bench mismatch happened."""
+    import sys
+    sys.path.insert(0, ".")
+    import bench
+    import bigdl_tpu.nn as nn
+
+    step, x, y = bench.make_step("inception_v1_imagenet", batch=2)
+    names = [m.get_name() or "" for m in step.model.modules()]
+    assert any("+" in n for n in names), "no merged sibling convs in bench model"
+    assert any(n.endswith("/s2d") for n in names), "no s2d conv1 in bench model"
+    assert x.shape[0] == 2
