@@ -146,7 +146,7 @@ def make_drain(step):
     return drain
 
 
-def run_config(name, build_model, build_batch, criterion, batch, iters):
+def run_config(name, batch, iters):
     step, x, y = make_step(name, batch)
 
     # ALL timed iterations run inside ONE dispatch (lax.scan over the
@@ -222,9 +222,8 @@ def main():
     results = {}
     for name in names:
         try:
-            build_model, build_batch, criterion, batch = cfgs[name]
-            results[name] = run_config(name, build_model, build_batch,
-                                       criterion, batch, iters)
+            *_, batch = cfgs[name]
+            results[name] = run_config(name, batch, iters)
         except Exception as e:  # noqa: BLE001 — one config must not sink the rest
             results[name] = {"error": f"{type(e).__name__}: {e}"}
         print(f"# {name}: {results[name]}", file=sys.stderr, flush=True)

@@ -36,7 +36,9 @@ _LIB_PATH = os.path.join(_DIR, "libbigdl_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
-_disabled = False  # no_native seen once -> short-circuit (hot paths)
+_disabled = False  # no_native seen -> short-circuit (hot paths)
+_disabled_env: Optional[str] = None   # BIGDL_TPU_NO_NATIVE when latched
+_disabled_cfg = None                  # installed config object when latched
 
 
 def _try_load() -> Optional[ctypes.CDLL]:
@@ -44,15 +46,26 @@ def _try_load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     if _lib is not None:
         return _lib
-    global _disabled
-    if _build_failed or _disabled:
+    global _disabled, _disabled_env, _disabled_cfg
+    if _build_failed:
         return None
+    from bigdl_tpu.utils import config as _cfgmod
     from bigdl_tpu.utils.config import get_config
 
+    if _disabled:
+        # latched while no_native was truthy; stay latched only while
+        # BOTH knob sources (env var, installed config) are unchanged so
+        # clearing either re-enables native like every other BIGDL_* knob
+        if (os.environ.get("BIGDL_TPU_NO_NATIVE") == _disabled_env
+                and _cfgmod._config is _disabled_cfg):
+            return None
+        _disabled = False
     if get_config().no_native:
         # cache the decision: _try_load sits on per-record hot paths
         # (crc32c framing), so don't re-resolve the config every call
         _disabled = True
+        _disabled_env = os.environ.get("BIGDL_TPU_NO_NATIVE")
+        _disabled_cfg = _cfgmod._config
         return None
     with _lock:
         if _lib is not None:

@@ -94,7 +94,8 @@ def _leading_conv(branch: Module) -> Optional[Tuple[SpatialConvolution, List[Mod
 def _signature(conv: SpatialConvolution):
     return (conv.n_input_plane, conv.kernel_w, conv.kernel_h,
             conv.stride_w, conv.stride_h, conv.pad_w, conv.pad_h,
-            conv.with_bias, conv.format, conv.propagate_back)
+            conv.with_bias, conv.format, conv.propagate_back,
+            str(conv.weight.dtype))
 
 
 def _merged_conv_of(convs) -> SpatialConvolution:

@@ -33,6 +33,12 @@ class DLEstimator:
         self.max_epoch = 20
         self.learning_rate = 1e-3
         self.optim_method = None
+        self.end_trigger = None
+        self.mesh = None
+        self.validation = None  # (trigger, X, y, methods, batch_size)
+        self.train_summary = None
+        self.validation_summary = None
+        self.checkpoint = None  # (path, trigger)
 
     def set_batch_size(self, n: int) -> "DLEstimator":
         self.batch_size = n
@@ -50,6 +56,39 @@ class DLEstimator:
         self.optim_method = method
         return self
 
+    def set_end_trigger(self, trigger) -> "DLEstimator":
+        """Override the max-epoch end condition (``DLEstimator.scala``
+        endWhen param)."""
+        self.end_trigger = trigger
+        return self
+
+    def set_mesh(self, mesh) -> "DLEstimator":
+        """Train on a device mesh via DistriOptimizer instead of the
+        single-chip LocalOptimizer."""
+        self.mesh = mesh
+        return self
+
+    def set_validation(self, trigger, X, y, methods,
+                       batch_size: Optional[int] = None) -> "DLEstimator":
+        """Schedule validation during fit (Optimizer.setValidation
+        pass-through over columnar arrays).  ``batch_size=None`` resolves
+        to the training batch size AT FIT TIME, so setter order doesn't
+        matter."""
+        self.validation = (trigger, X, y, methods, batch_size)
+        return self
+
+    def set_train_summary(self, summary) -> "DLEstimator":
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary) -> "DLEstimator":
+        self.validation_summary = summary
+        return self
+
+    def set_checkpoint(self, path: str, trigger) -> "DLEstimator":
+        self.checkpoint = (path, trigger)
+        return self
+
     def _make_model(self, trained):
         return DLModel(trained, self.feature_size)
 
@@ -62,11 +101,29 @@ class DLEstimator:
         samples = [Sample(X[i], y[i]) for i in range(len(X))]
         method = self.optim_method or optim.Adam(
             learning_rate=self.learning_rate)
-        o = optim.LocalOptimizer(
-            self.model, samples, self.criterion,
-            batch_size=self.batch_size,
-            end_trigger=optim.Trigger.max_epoch(self.max_epoch))
+        end = self.end_trigger or optim.Trigger.max_epoch(self.max_epoch)
+        if self.mesh is not None:
+            o = optim.DistriOptimizer(self.model, samples, self.criterion,
+                                      batch_size=self.batch_size,
+                                      end_trigger=end, mesh=self.mesh)
+        else:
+            o = optim.LocalOptimizer(self.model, samples, self.criterion,
+                                     batch_size=self.batch_size,
+                                     end_trigger=end)
         o.set_optim_method(method)
+        if self.validation is not None:
+            trigger, vX, vy, methods, vbatch = self.validation
+            vX = np.asarray(vX, np.float32).reshape((-1,) + self.feature_size)
+            vy = np.asarray(vy).reshape((-1,) + self.label_size)
+            vsamples = [Sample(vX[i], vy[i]) for i in range(len(vX))]
+            o.set_validation(trigger, vsamples, methods,
+                             vbatch or self.batch_size)
+        if self.train_summary is not None:
+            o.set_train_summary(self.train_summary)
+        if self.validation_summary is not None:
+            o.set_validation_summary(self.validation_summary)
+        if self.checkpoint is not None:
+            o.set_checkpoint(self.checkpoint[0], self.checkpoint[1])
         trained = o.optimize()
         return self._make_model(trained)
 

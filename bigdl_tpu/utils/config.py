@@ -25,6 +25,9 @@ test (or an embedding application) can inject overrides with
 | profile_dir            | BIGDL_PROFILE               | profiler hook |
 | profile_iters          | BIGDL_PROFILE_ITERS         | profiler hook |
 | no_native              | BIGDL_TPU_NO_NATIVE         | native kernel loader |
+| log_disable            | BIGDL_LOGGER_DISABLE        | utils.logging redirect (disable) |
+| log_file               | BIGDL_LOG_FILE              | utils.logging redirect target |
+| log_thirdparty         | BIGDL_LOG_THIRDPARTY        | redirect third-party logs to file |
 """
 
 from __future__ import annotations
@@ -61,6 +64,10 @@ class BigDLConfig:
     profile_iters: int = 5
     # native layer
     no_native: bool = False
+    # log management (LoggerFilter.scala property family)
+    log_disable: bool = False
+    log_file: Optional[str] = None
+    log_thirdparty: bool = True
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -87,6 +94,9 @@ class BigDLConfig:
             profile_dir=env.get("BIGDL_PROFILE") or None,
             profile_iters=_int("BIGDL_PROFILE_ITERS", 5),
             no_native=_truthy(env.get("BIGDL_TPU_NO_NATIVE")),
+            log_disable=_truthy(env.get("BIGDL_LOGGER_DISABLE")),
+            log_file=env.get("BIGDL_LOG_FILE") or None,
+            log_thirdparty=_truthy(env.get("BIGDL_LOG_THIRDPARTY") or "true"),
         )
 
 

@@ -33,7 +33,23 @@ import numpy as np
 
 from bigdl_tpu.utils.config import get_config
 
-__all__ = ["Engine"]
+__all__ = ["Engine", "honor_platform_request"]
+
+
+def honor_platform_request() -> None:
+    """Re-assert an explicit ``JAX_PLATFORMS`` request via ``jax.config``.
+
+    An externally-registered PJRT plugin (e.g. the axon TPU tunnel's
+    sitecustomize hook) can win platform selection even when the user
+    exported ``JAX_PLATFORMS=cpu`` — so a CLI run the user explicitly
+    pinned to CPU would still dial the device tunnel.  Call this before
+    the first backend touch; no-op when no explicit request exists or the
+    request includes the plugin platform."""
+    req = (os.environ.get("JAX_PLATFORMS") or "").strip()
+    if req and "axon" not in req and "tpu" not in req:
+        import jax
+
+        jax.config.update("jax_platforms", req)
 
 
 class _Engine:
@@ -83,6 +99,7 @@ class _Engine:
         """
         import jax
 
+        honor_platform_request()
         # BEFORE the first jax.devices(): a second driver must be caught
         # while this process can still report it rather than hang in the
         # device claim (see check_singleton)
@@ -186,7 +203,10 @@ class _Engine:
         """Lock identity from env/config only.  Best-effort by design:
         two processes must agree on JAX_PLATFORMS/TPU_VISIBLE_DEVICES
         spelling to collide on the same lockfile (an advisory guard for
-        the common same-launcher case, not a security boundary)."""
+        the common same-launcher case, not a security boundary).  The
+        path is scoped per-user (XDG_RUNTIME_DIR when available, else a
+        uid-tagged name under the shared tmpdir) so one user's lockfile
+        can neither be pre-planted nor flock-held by another."""
         import tempfile
 
         parts = [self._singleton_platform(),
@@ -194,7 +214,12 @@ class _Engine:
                  f"p{get_config().process_id}"]
         tag = "".join(c if c.isalnum() or c in "p_" else "_"
                       for c in "_".join(parts))
-        return os.path.join(tempfile.gettempdir(), f"bigdl_tpu_{tag}.lock")
+        uid = os.getuid() if hasattr(os, "getuid") else 0
+        run_dir = os.environ.get("XDG_RUNTIME_DIR")
+        if run_dir and os.path.isdir(run_dir):
+            return os.path.join(run_dir, f"bigdl_tpu_{tag}.lock")
+        return os.path.join(tempfile.gettempdir(),
+                            f"bigdl_tpu_u{uid}_{tag}.lock")
 
     def check_singleton(self, raise_on_conflict: Optional[bool] = None,
                         force: bool = False) -> bool:
@@ -228,8 +253,12 @@ class _Engine:
         if raise_on_conflict is None:
             raise_on_conflict = get_config().check_singleton_strict
         path = self._singleton_lock_path()
+        flags = os.O_CREAT | os.O_RDWR
+        # never follow a pre-planted symlink at the (predictable) path;
+        # ELOOP from O_NOFOLLOW lands in the advisory-skip branch below
+        flags |= getattr(os, "O_NOFOLLOW", 0) | getattr(os, "O_CLOEXEC", 0)
         try:
-            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+            fd = os.open(path, flags, 0o600)
         except OSError as e:
             log.warning(f"singleton check skipped: cannot open {path}: {e}")
             return True

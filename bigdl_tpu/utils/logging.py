@@ -1,0 +1,142 @@
+"""Log management — the reference's ``LoggerFilter``
+(``utils/LoggerFilter.scala:33-134``) rebuilt on :mod:`logging`.
+
+The reference's problem: Spark/Akka/Breeze INFO spam drowns the training
+progress lines, so ``redirectSparkInfoLogs`` sends third-party INFO to a
+file (default ``$PWD/bigdl.log``), keeps third-party console output at
+ERROR, and leaves framework logs on the console.  The TPU-native noise
+sources are different (jax/absl compile chatter, TensorFlow import
+banners, fsspec/urllib3 wire logs) but the operability contract is the
+same:
+
+1. ``redirect_thirdparty_logs()`` — everything still lands in the log
+   file; the console only shows third-party ERRORs and framework INFO.
+2. ``BIGDL_LOGGER_DISABLE=true`` disables redirection entirely
+   (``bigdl.utils.LoggerFilter.disable``).
+3. ``BIGDL_LOG_FILE`` overrides the file path
+   (``bigdl.utils.LoggerFilter.logFile``).
+4. ``BIGDL_LOG_THIRDPARTY=false`` keeps third-party records out of the
+   file too (``bigdl.utils.LoggerFilter.enableSparkLog``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Sequence
+
+from bigdl_tpu.utils.config import get_config
+
+__all__ = ["redirect_thirdparty_logs", "undo_redirect", "FRAMEWORK_LOGGER",
+           "NOISY_LOGGERS"]
+
+FRAMEWORK_LOGGER = "bigdl_tpu"
+
+# the tpu-stack analogue of the reference's List("org", "akka", "breeze")
+NOISY_LOGGERS = ("jax", "jaxlib", "absl", "tensorflow", "orbax", "flax",
+                 "fsspec", "urllib3", "etils")
+
+_PATTERN = "%(asctime)s %(levelname)-5s %(name)s:%(lineno)d - %(message)s"
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+# handlers we installed, so redirect is idempotent and undoable
+_installed: List[tuple] = []
+_saved_levels: List[tuple] = []
+
+
+def _formatter() -> logging.Formatter:
+    return logging.Formatter(_PATTERN, _DATEFMT)
+
+
+def _file_handler(path: str, level=logging.INFO) -> logging.FileHandler:
+    # delay=True: don't create the file until a record actually lands
+    h = logging.FileHandler(path, mode="a", encoding="utf-8", delay=True)
+    h.setLevel(level)
+    h.setFormatter(_formatter())
+    h.set_name("bigdl_file")
+    return h
+
+
+def _console_handler(level=logging.INFO) -> logging.StreamHandler:
+    import sys
+
+    h = logging.StreamHandler(sys.stdout)
+    h.setLevel(level)
+    h.setFormatter(_formatter())
+    h.set_name("bigdl_console")
+    return h
+
+
+def redirect_thirdparty_logs(log_path: Optional[str] = None,
+                             noisy: Sequence[str] = NOISY_LOGGERS) -> Optional[str]:
+    """Route noisy third-party INFO to a file, keep the console clean.
+
+    Mirrors ``LoggerFilter.redirectSparkInfoLogs`` (``LoggerFilter.scala:91``):
+
+    - each noisy logger gets a console handler at ERROR and (when
+      ``log_thirdparty``) a file handler at INFO, with propagation cut
+      (the reference's ``setAdditivity(false)``);
+    - the framework logger keeps console INFO and also writes the file;
+    - idempotent — calling twice replaces, not duplicates, handlers.
+
+    Returns the log-file path, or ``None`` when disabled.
+    """
+    cfg = get_config()
+    if cfg.log_disable:
+        return None
+    path = cfg.log_file or log_path or os.path.join(os.getcwd(), "bigdl.log")
+    if os.path.isdir(path):
+        logging.getLogger(FRAMEWORK_LOGGER).error(
+            "%s exists and is a directory; can't redirect to it", path)
+        return None
+    undo_redirect()
+
+    file_h = _file_handler(path)  # ONE shared fd for every logger
+    for name in noisy:
+        lg = logging.getLogger(name)
+        console = _console_handler(logging.ERROR)
+        lg.addHandler(console)
+        _installed.append((lg, console, lg.propagate))
+        if cfg.log_thirdparty:
+            lg.addHandler(file_h)
+            _installed.append((lg, file_h, lg.propagate))
+        lg.propagate = False
+        # a NOTSET noisy logger would inherit root's WARNING and drop the
+        # INFO records before the file handler sees them
+        _saved_levels.append((lg, lg.level))
+        if lg.level == logging.NOTSET or lg.level > logging.INFO:
+            lg.setLevel(logging.INFO)
+
+    fw = logging.getLogger(FRAMEWORK_LOGGER)
+    for h in (_console_handler(logging.INFO), file_h):
+        fw.addHandler(h)
+        _installed.append((fw, h, fw.propagate))
+    fw.propagate = False
+    _saved_levels.append((fw, fw.level))
+    if fw.level == logging.NOTSET:
+        fw.setLevel(logging.INFO)
+
+    # everything else still reaches the file through the root logger
+    root = logging.getLogger()
+    root.addHandler(file_h)
+    _installed.append((root, file_h, root.propagate))
+    return path
+
+
+def undo_redirect() -> None:
+    """Remove every handler :func:`redirect_thirdparty_logs` installed and
+    restore propagation (tests / embedding apps)."""
+    seen_propagate = {}
+    for lg, h, propagate in _installed:
+        lg.removeHandler(h)
+        try:
+            h.close()
+        except Exception:
+            pass
+        seen_propagate.setdefault(id(lg), (lg, propagate))
+    for lg, propagate in seen_propagate.values():
+        lg.propagate = propagate
+    for lg, level in _saved_levels:
+        lg.setLevel(level)
+    _installed.clear()
+    _saved_levels.clear()

@@ -1,0 +1,74 @@
+"""LoggerFilter analogue (``utils/LoggerFilter.scala:33-134``): noisy
+third-party INFO goes to the file, console keeps only their ERRORs and
+framework logs; property knobs disable/redirect."""
+
+import logging
+import os
+
+import bigdl_tpu.utils.logging as blog
+from bigdl_tpu.utils.config import BigDLConfig, set_config
+
+
+def teardown_function(_fn):
+    blog.undo_redirect()
+    set_config(None)
+
+
+def _records_in(path):
+    with open(path) as f:
+        return f.read()
+
+
+def test_redirect_sends_thirdparty_info_to_file_not_console(tmp_path, capsys):
+    log_file = str(tmp_path / "bigdl.log")
+    out = blog.redirect_thirdparty_logs(log_file)
+    assert out == log_file
+
+    # no manual setLevel: the redirect itself must make noisy INFO
+    # records reach the file (NOTSET would inherit root's WARNING)
+    noisy = logging.getLogger("jax")
+    noisy.info("compile chatter %d", 7)
+    noisy.error("device exploded")
+    fw = logging.getLogger("bigdl_tpu")
+    fw.info("epoch 1 done")
+
+    captured = capsys.readouterr().out
+    assert "compile chatter" not in captured      # INFO spam off console
+    assert "device exploded" in captured          # third-party ERROR kept
+    assert "epoch 1 done" in captured             # framework INFO kept
+
+    content = _records_in(log_file)
+    assert "compile chatter 7" in content
+    assert "epoch 1 done" in content
+
+
+def test_redirect_disable_knob(tmp_path):
+    set_config(BigDLConfig(log_disable=True))
+    assert blog.redirect_thirdparty_logs(str(tmp_path / "x.log")) is None
+    assert not os.path.exists(tmp_path / "x.log")
+
+
+def test_redirect_log_file_knob_and_no_thirdparty(tmp_path):
+    target = str(tmp_path / "override.log")
+    set_config(BigDLConfig(log_file=target, log_thirdparty=False))
+    out = blog.redirect_thirdparty_logs(str(tmp_path / "ignored.log"))
+    assert out == target
+
+    noisy = logging.getLogger("tensorflow")
+    noisy.info("import banner")
+    logging.getLogger("bigdl_tpu").info("still filed")
+
+    content = _records_in(target)
+    assert "import banner" not in content   # enableSparkLog=false analogue
+    assert "still filed" in content
+
+
+def test_redirect_idempotent(tmp_path, capsys):
+    log_file = str(tmp_path / "bigdl.log")
+    blog.redirect_thirdparty_logs(log_file)
+    blog.redirect_thirdparty_logs(log_file)  # second call replaces handlers
+
+    lg = logging.getLogger("absl")
+    lg.setLevel(logging.ERROR)
+    lg.error("once")
+    assert capsys.readouterr().out.count("once") == 1

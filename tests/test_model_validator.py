@@ -1,0 +1,113 @@
+"""ModelValidator example — the reference's interop acceptance harness
+(``example/loadmodel/ModelValidator.scala:44``): one model saved through
+every serialization format must report identical Top-1/Top-5 over the
+same validation folder."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bigdl_tpu.nn as nn
+from examples.model_validator import (load_model, load_validation_samples,
+                                      main, validate)
+
+
+@pytest.fixture(scope="module")
+def trained_cnn():
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(8)
+    return nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, -1, -1).set_name("conv1"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([4 * 4 * 4]),
+        nn.Linear(4 * 4 * 4, 8).set_name("fc"),
+        nn.SoftMax(),  # Caffe has no LogSoftmax layer; argmax order is equal
+    ).evaluate()
+
+
+@pytest.fixture(scope="module")
+def val_folder(tmp_path_factory):
+    """Class-subdir validation tree of .npy features (8 classes x 4)."""
+    root = tmp_path_factory.mktemp("val")
+    rng = np.random.RandomState(0)
+    for c in range(8):
+        d = root / f"class_{c}"
+        d.mkdir()
+        for i in range(4):
+            np.save(d / f"{i}.npy",
+                    rng.randn(3, 8, 8).astype(np.float32))
+    return str(root)
+
+
+def _save_all_formats(model, tmpdir):
+    from bigdl_tpu.utils.caffe_persister import save_caffe
+    from bigdl_tpu.utils.serializer import save_module
+    from bigdl_tpu.utils.tf_graph import save_graphdef
+    from bigdl_tpu.utils.torch_file import save_torch
+
+    paths = {}
+    btpu = os.path.join(tmpdir, "m.btpu")
+    save_module(model, btpu)
+    paths["bigdl"] = dict(model_path=btpu)
+
+    proto = os.path.join(tmpdir, "m.prototxt")
+    weights = os.path.join(tmpdir, "m.caffemodel")
+    save_caffe(model, proto, weights, input_shapes=(1, 3, 8, 8))
+    paths["caffe"] = dict(model_path=weights, caffe_def_path=proto)
+
+    t7 = os.path.join(tmpdir, "m.t7")
+    save_torch(model, t7)
+    paths["torch"] = dict(model_path=t7)
+
+    pb = os.path.join(tmpdir, "m.pb")
+    outs = save_graphdef(model, pb, input_name="input")
+    paths["tf"] = dict(model_path=pb, tf_input="input", tf_output=outs[0])
+    return paths
+
+
+def test_all_four_formats_agree(trained_cnn, val_folder, tmp_path):
+    samples = load_validation_samples(val_folder)
+    assert len(samples) == 32
+    fmts = _save_all_formats(trained_cnn, str(tmp_path))
+    scores = {}
+    for fmt, kw in fmts.items():
+        model = load_model(fmt, **kw)
+        scores[fmt] = validate(model, samples, batch_size=16)
+        assert set(scores[fmt]) == {"Top1Accuracy", "Top5Accuracy"}
+    ref = scores["bigdl"]
+    for fmt in ("caffe", "torch", "tf"):
+        for k in ref:
+            assert scores[fmt][k] == pytest.approx(ref[k], abs=1e-6), (fmt, k)
+    # 8 balanced random classes: top-5 must beat top-1 on any real model
+    assert ref["Top5Accuracy"] >= ref["Top1Accuracy"]
+
+
+def test_cli_end_to_end_npz(trained_cnn, tmp_path, capsys):
+    """The CLI path over an .npz validation file."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 8, 16)
+    npz = str(tmp_path / "val.npz")
+    np.savez(npz, x=x, y=y)
+    fmts = _save_all_formats(trained_cnn, str(tmp_path))
+    scores = main(["-t", "bigdl", "--modelPath", fmts["bigdl"]["model_path"],
+                   "-f", npz, "-b", "8"])
+    out = capsys.readouterr().out
+    assert "Top1Accuracy" in out and "Top5Accuracy" in out
+    assert 0.0 <= scores["Top1Accuracy"] <= 1.0
+
+
+def test_mean_file_subtraction(trained_cnn, val_folder, tmp_path):
+    mean = np.full((3, 8, 8), 0.5, np.float32)
+    mean_path = str(tmp_path / "mean.npy")
+    np.save(mean_path, mean)
+    plain = load_validation_samples(val_folder)
+    shifted = load_validation_samples(val_folder, mean_file=mean_path)
+    np.testing.assert_allclose(np.asarray(shifted[0].feature) + 0.5,
+                               np.asarray(plain[0].feature), rtol=1e-6)

@@ -109,6 +109,44 @@ def test_dl_classifier_pipeline():
     assert (pred == y).mean() > 0.9
 
 
+def test_dl_estimator_passthrough_options():
+    """The estimator must forward mesh / end-trigger / validation /
+    summary / optim-method choices to the Optimizer instead of hardcoding
+    defaults (``DLEstimator.scala`` param surface)."""
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.parallel.mesh import make_mesh
+    from bigdl_tpu.pipeline import DLClassifier
+    from bigdl_tpu.utils.rng import RNG
+
+    class FakeSummary:
+        def __init__(self):
+            self.tags = []
+
+        def add_scalar(self, tag, value, step):
+            self.tags.append(tag)
+
+    RNG.set_seed(4)
+    rng = np.random.RandomState(4)
+    X = rng.randn(128, 4).astype(np.float32)
+    y = (X[:, 0] - X[:, 2] > 0).astype(np.int64)
+    model = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    ts, vs = FakeSummary(), FakeSummary()
+    est = DLClassifier(model, nn.ClassNLLCriterion(), [4]) \
+        .set_batch_size(32) \
+        .set_optim_method(optim.SGD(learning_rate=0.5)) \
+        .set_mesh(make_mesh()) \
+        .set_end_trigger(optim.Trigger.max_iteration(40)) \
+        .set_validation(optim.Trigger.several_iteration(10), X, y,
+                        [optim.Top1Accuracy()]) \
+        .set_train_summary(ts).set_validation_summary(vs)
+    fitted = est.fit(X, y)
+    pred = fitted.transform(X)
+    assert (pred == y).mean() > 0.9
+    assert "Loss" in ts.tags
+    assert "Top1Accuracy" in vs.tags
+
+
 def test_dl_estimator_regression():
     import bigdl_tpu.optim as optim
     from bigdl_tpu.pipeline import DLEstimator
