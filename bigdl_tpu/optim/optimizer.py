@@ -56,6 +56,85 @@ if not log.handlers:
     log.setLevel(logging.INFO)
 
 
+class _BatchPrefetcher:
+    """Double-buffered input pipeline: a host thread pulls batches from
+    the dataset iterator (running the whole host transform chain) and
+    places them on the mesh (h2d) while the device crunches the previous
+    step.  The reference overlaps input the same way with its dedicated
+    multithreaded transform+batch pipeline
+    (``dataset/image/MTLabeledBGRImgToBatch.scala:31``); under JAX the
+    device dispatch is already async, so pulling transform+h2d off the
+    driver thread is the missing half of the overlap — with it, the
+    Metrics ``data time`` stage collapses to queue-pop time (~0 when the
+    pipeline keeps up).
+
+    ``depth`` bounds the batches in flight (2 = classic double buffering,
+    also bounding device memory for staged inputs)."""
+
+    class _Error:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def __init__(self, data_iter, place_fn, depth: int, metrics: Metrics):
+        import queue
+        import threading
+
+        self._it = data_iter
+        self._place = place_fn
+        self._metrics = metrics
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, name="bigdl-prefetch", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch in self._it:
+                if self._stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                placed = self._place(batch.get_input(), batch.get_target())
+                self._metrics.add("host to device time",
+                                  time.perf_counter() - t0)
+                self._put_stop_aware((batch.size(), placed))
+            else:
+                self._put_stop_aware(None)  # iterator exhausted
+        except BaseException as e:  # noqa: BLE001 — surfaced on next()
+            # the same stop-aware retry as the item path: dropping the
+            # error sentinel would leave the driver blocked in next()
+            self._put_stop_aware(self._Error(e))
+
+    def _put_stop_aware(self, item):
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.5)
+                return
+            except queue.Full:
+                continue
+
+    def next(self):
+        """(global_batch_size, placed_arrays) or None when exhausted;
+        re-raises any producer-side failure on the driver thread (so the
+        retry loop sees data errors exactly like compute errors)."""
+        item = self._q.get()
+        if isinstance(item, self._Error):
+            raise item.exc
+        return item
+
+    def close(self):
+        self._stop.set()
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        self._thread.join(timeout=5.0)
+
+
 class Optimizer:
     """Factory + base driver.  ``Optimizer(model=..., dataset=...,
     criterion=...)`` picks Local vs Distri by Engine topology, mirroring
@@ -354,7 +433,16 @@ class Optimizer:
             record_scale = 1
         records_this_epoch = self.state.get("records", 0)
         data_iter = self.dataset.data(train=True)
+        # the driver's seed draw happens BEFORE the prefetch thread starts
+        # pulling batches through (possibly random) transforms, so the
+        # shared host RNG sees the same draw order as the synchronous path
         key0 = jax.random.key(RNG.randint(0, 2**31 - 1))
+        # async input: transform + h2d run ahead of the device step on a
+        # host thread (BIGDL_PREFETCH=0 restores the synchronous path)
+        prefetch_depth = get_config().prefetch_batches
+        prefetcher = _BatchPrefetcher(
+            data_iter, step._shard_batch, prefetch_depth, self.metrics) \
+            if prefetch_depth > 0 else None
         epoch_start = time.perf_counter()
 
         # profiler hook: BIGDL_PROFILE=<dir> traces the first
@@ -373,14 +461,24 @@ class Optimizer:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
                 t_start = time.perf_counter()
-                batch: MiniBatch = next(data_iter)
+                if prefetcher is not None:
+                    item = prefetcher.next()
+                    if item is None:
+                        break  # iterator exhausted (finite feeds)
+                    batch_n, placed = item
+                else:
+                    batch: MiniBatch = next(data_iter)
+                    batch_n, placed = batch.size(), None
                 t_data = time.perf_counter()
                 key = jax.random.fold_in(key0, self.state["neval"])
 
                 def one_iteration():
                     th0 = time.perf_counter()
-                    xs, ys = step._shard_batch(batch.get_input(),
-                                               batch.get_target())
+                    if placed is not None:
+                        xs, ys = placed  # h2d already done by the prefetcher
+                    else:
+                        xs, ys = step._shard_batch(batch.get_input(),
+                                                   batch.get_target())
                     t0 = time.perf_counter()
                     out = step.run_sharded(xs, ys, key)
                     t1 = time.perf_counter()
@@ -398,7 +496,8 @@ class Optimizer:
                     loss, stage_times = \
                         self._run_with_straggler_guard(one_iteration)
                 h2d_s, dispatch_s, sync_s = stage_times
-                self.metrics.add("host to device time", h2d_s)
+                if prefetcher is None:  # else the worker thread records it
+                    self.metrics.add("host to device time", h2d_s)
                 self.metrics.add("dispatch time", dispatch_s)
                 self.metrics.add("compile + first iteration time" if
                                  first_iteration else "computing time",
@@ -412,7 +511,7 @@ class Optimizer:
                         profiling = False
                         log.info(
                             f"[Optimizer] profiler trace in {profile_dir}")
-                n = batch.size() * record_scale  # global records this iteration
+                n = batch_n * record_scale  # global records this iteration
                 self.state["neval"] += 1
                 self.state["loss"] = loss
                 records_this_epoch += n
@@ -461,7 +560,20 @@ class Optimizer:
                 if self._ckpt_trigger is not None and self._ckpt_trigger(self.state):
                     with self.metrics.timer("checkpoint time"):
                         self._save_checkpoint(step)
+        except BaseException:
+            # the compiled step DONATES param/opt buffers, so the module
+            # tree's original arrays are already deleted after the first
+            # iteration — write the last-completed-iteration params back
+            # before the retry loop rebuilds a TrainStep from the model
+            # ("restart from current weights" must mean CURRENT)
+            try:
+                step.sync_to_model()
+            except Exception:
+                log.warning("could not sync params to model after failure")
+            raise
         finally:
+            if prefetcher is not None:
+                prefetcher.close()
             if profiling:
                 jax.profiler.stop_trace()
                 log.info(f"[Optimizer] profiler trace in {profile_dir}")

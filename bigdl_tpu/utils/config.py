@@ -28,6 +28,7 @@ test (or an embedding application) can inject overrides with
 | log_disable            | BIGDL_LOGGER_DISABLE        | utils.logging redirect (disable) |
 | log_file               | BIGDL_LOG_FILE              | utils.logging redirect target |
 | log_thirdparty         | BIGDL_LOG_THIRDPARTY        | redirect third-party logs to file |
+| prefetch_batches       | BIGDL_PREFETCH              | Optimizer input double-buffering depth (0 = sync) |
 """
 
 from __future__ import annotations
@@ -68,6 +69,8 @@ class BigDLConfig:
     log_disable: bool = False
     log_file: Optional[str] = None
     log_thirdparty: bool = True
+    # input pipeline: batches to transform+transfer ahead of the device
+    prefetch_batches: int = 2
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -97,6 +100,7 @@ class BigDLConfig:
             log_disable=_truthy(env.get("BIGDL_LOGGER_DISABLE")),
             log_file=env.get("BIGDL_LOG_FILE") or None,
             log_thirdparty=_truthy(env.get("BIGDL_LOG_THIRDPARTY") or "true"),
+            prefetch_batches=_int("BIGDL_PREFETCH", 2),
         )
 
 

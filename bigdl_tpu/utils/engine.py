@@ -206,7 +206,11 @@ class _Engine:
         the common same-launcher case, not a security boundary).  The
         path is scoped per-user (XDG_RUNTIME_DIR when available, else a
         uid-tagged name under the shared tmpdir) so one user's lockfile
-        can neither be pre-planted nor flock-held by another."""
+        can neither be pre-planted nor flock-held by another.  Deliberate
+        tradeoff: CROSS-user double-driver contention is no longer
+        pre-empted here — a world-writable rendezvous path is exactly the
+        symlink/DoS surface this scoping removes; cross-user claims
+        surface as the device claim error instead."""
         import tempfile
 
         parts = [self._singleton_platform(),
@@ -292,6 +296,7 @@ class _Engine:
 
         if timeout_s is None:
             timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT", "300"))
+        honor_platform_request()
         self.check_singleton(raise_on_conflict=True)
         done = threading.Event()
         state: dict = {}

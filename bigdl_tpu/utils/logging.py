@@ -42,6 +42,7 @@ _DATEFMT = "%Y-%m-%d %H:%M:%S"
 # handlers we installed, so redirect is idempotent and undoable
 _installed: List[tuple] = []
 _saved_levels: List[tuple] = []
+_removed_child: List[tuple] = []  # child-logger handlers lifted during redirect
 
 
 def _formatter() -> logging.Formatter:
@@ -112,6 +113,16 @@ def redirect_thirdparty_logs(log_path: Optional[str] = None,
         fw.addHandler(h)
         _installed.append((fw, h, fw.propagate))
     fw.propagate = False
+    # child framework loggers (e.g. bigdl_tpu.optim) install a fallback
+    # StreamHandler when imported before this redirect; records would now
+    # be emitted twice (child handler + propagate to fw's console) — lift
+    # the child handlers for the redirect's lifetime
+    for name, lg in list(logging.root.manager.loggerDict.items()):
+        if (isinstance(lg, logging.Logger)
+                and name.startswith(FRAMEWORK_LOGGER + ".")):
+            for h in list(lg.handlers):
+                lg.removeHandler(h)
+                _removed_child.append((lg, h))
     _saved_levels.append((fw, fw.level))
     if fw.level == logging.NOTSET:
         fw.setLevel(logging.INFO)
@@ -138,5 +149,8 @@ def undo_redirect() -> None:
         lg.propagate = propagate
     for lg, level in _saved_levels:
         lg.setLevel(level)
+    for lg, h in _removed_child:
+        lg.addHandler(h)
     _installed.clear()
     _saved_levels.clear()
+    _removed_child.clear()

@@ -40,29 +40,39 @@ class RandomGenerator:
     def __init__(self, seed: int | None = None):
         self._seed = seed if seed is not None else 0
         self._gen = np.random.Generator(np.random.MT19937(self._seed))
+        # host draws can come from the driver AND the input-prefetch
+        # thread (random crop/flip in the transform chain); MT19937 state
+        # updates are not atomic, so serialize every draw
+        self._lock = threading.Lock()
 
     def set_seed(self, seed: int) -> "RandomGenerator":
-        self._seed = int(seed)
-        self._gen = np.random.Generator(np.random.MT19937(self._seed))
+        with self._lock:
+            self._seed = int(seed)
+            self._gen = np.random.Generator(np.random.MT19937(self._seed))
         return self
 
     def get_seed(self) -> int:
         return self._seed
 
     def uniform(self, a: float = 0.0, b: float = 1.0, size=None) -> np.ndarray:
-        return self._gen.uniform(a, b, size=size)
+        with self._lock:
+            return self._gen.uniform(a, b, size=size)
 
     def normal(self, mean: float = 0.0, stdv: float = 1.0, size=None) -> np.ndarray:
-        return self._gen.normal(mean, stdv, size=size)
+        with self._lock:
+            return self._gen.normal(mean, stdv, size=size)
 
     def bernoulli(self, p: float, size=None) -> np.ndarray:
-        return (self._gen.uniform(0.0, 1.0, size=size) < p).astype(np.float32)
+        with self._lock:
+            return (self._gen.uniform(0.0, 1.0, size=size) < p).astype(np.float32)
 
     def permutation(self, n: int) -> np.ndarray:
-        return self._gen.permutation(n)
+        with self._lock:
+            return self._gen.permutation(n)
 
     def randint(self, low: int, high: int, size=None) -> np.ndarray:
-        return self._gen.integers(low, high, size=size)
+        with self._lock:
+            return self._gen.integers(low, high, size=size)
 
 
 #: Global init-time RNG (thread-local in the reference; a process-global here —

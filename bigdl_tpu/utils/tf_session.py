@@ -18,6 +18,13 @@ names), ``QueueEnqueue(Many)V2``, ``QueueDequeue(Many/UpTo)V2``,
 ``ParseSingleExample`` / legacy variadic-key ``ParseExample`` (v1), with
 ``Identity``/control-dep and shape-only (``Reshape``/``ExpandDims``/
 ``Squeeze``) hops between.
+
+Supported topologies (round 4): several enqueues into one queue (streams
+union, ``handleDistriDequeue``); several dequeues over one queue (the
+stream splits round-robin between them, ``handleLocalDequeue``);
+dequeues over different queues (rows zip by index);
+``RandomShuffleQueue`` (host-side seeded shuffle); and queue-less graphs
+whose compute reads ``ParseExample`` outputs directly.
 """
 
 from __future__ import annotations
@@ -80,12 +87,19 @@ class TFTrainingSession:
             node = self._node(data_ins[0])
         return node
 
+    def _find_enqueues(self, queue_name: str) -> List[Dict]:
+        """ALL enqueue ops feeding a queue, in graph order — several
+        producers union into one stream (``Session.scala:216-226``
+        ``handleDistriDequeue`` reduces enqueue RDDs with union)."""
+        out = [n for n in self.nodes
+               if n["op"] in _ENQUEUE_OPS and n["inputs"]
+               and _split_ref(n["inputs"][0])[0] == queue_name]
+        if not out:
+            raise ValueError(f"no enqueue op found for queue {queue_name!r}")
+        return out
+
     def _find_enqueue(self, queue_name: str) -> Dict:
-        for n in self.nodes:
-            if n["op"] in _ENQUEUE_OPS and n["inputs"] \
-                    and _split_ref(n["inputs"][0])[0] == queue_name:
-                return n
-        raise ValueError(f"no enqueue op found for queue {queue_name!r}")
+        return self._find_enqueues(queue_name)[0]
 
     def _filenames(self, queue_ref: str) -> List[str]:
         """Filename queue -> the Const string list enqueued into it."""
@@ -164,15 +178,8 @@ class TFTrainingSession:
                 f"reader {reader_impl['op']} unsupported (want TFRecord)")
         return self._filenames(reader["inputs"][1])
 
-    def interpret_pipeline(self, dequeue_name: str):
-        """dequeue node -> (filenames, [(key, dtype, shape)] per component).
-
-        Walks: dequeue -> its queue -> the enqueue feeding it -> each
-        enqueued component -> ParseExample dense output -> reader files.
-        """
-        deq = self.by_name[dequeue_name]
-        queue = self._follow_identity(deq["inputs"][0])
-        enq = self._find_enqueue(queue["name"])
+    def _enqueue_spec(self, enq: Dict):
+        """One enqueue op -> (filenames, comps)."""
         filenames: Optional[List[str]] = None
         comps: List[Tuple[str, object, List[int], List]] = []
         for ref in enq["inputs"][1:]:
@@ -193,8 +200,55 @@ class TFTrainingSession:
             elif filenames != files:
                 raise NotImplementedError("components read different files")
         if filenames is None:
-            raise ValueError(f"dequeue {dequeue_name!r} has no components")
+            raise ValueError(f"enqueue {enq['name']!r} has no components")
         return filenames, comps
+
+    def interpret_pipeline(self, dequeue_name: str):
+        """dequeue node -> (filenames, [(key, dtype, shape)] per component).
+
+        Walks: dequeue -> its queue -> every enqueue feeding it -> each
+        enqueued component -> ParseExample dense output -> reader files.
+        Several enqueues union their files (their component specs must
+        agree); kept for API compatibility — ``_dequeue_records`` is the
+        record-producing superset."""
+        deq = self.by_name[dequeue_name]
+        queue = self._follow_identity(deq["inputs"][0])
+        enqs = self._find_enqueues(queue["name"])
+        filenames, comps = self._enqueue_spec(enqs[0])
+        for other in enqs[1:]:
+            more_files, more_comps = self._enqueue_spec(other)
+            if [c[:3] for c in more_comps] != [c[:3] for c in comps]:
+                raise NotImplementedError(
+                    "enqueues into one queue carry different component "
+                    "specs")
+            filenames = filenames + more_files
+        return filenames, comps
+
+    def _dequeue_records(self, dequeue_name: str):
+        """(records, comps) for one dequeue: the union of its queue's
+        enqueue streams, shuffled when the queue is a RandomShuffleQueue
+        (host-side analogue of the queue's runtime semantics; seeded by
+        the global RNG so runs are reproducible)."""
+        deq = self.by_name[dequeue_name]
+        queue = self._follow_identity(deq["inputs"][0])
+        enqs = self._find_enqueues(queue["name"])
+        records: List[tuple] = []
+        comps = None
+        for enq in enqs:
+            files, c = self._enqueue_spec(enq)
+            if comps is None:
+                comps = c
+            elif [x[:3] for x in c] != [x[:3] for x in comps]:
+                raise NotImplementedError(
+                    "enqueues into one queue carry different component "
+                    "specs")
+            records.extend(self._records(files, c))
+        if queue["op"] in ("RandomShuffleQueueV2", "RandomShuffleQueue"):
+            from bigdl_tpu.utils.rng import RNG
+
+            order = np.asarray(RNG.permutation(len(records)))
+            records = [records[int(i)] for i in order]
+        return records, comps
 
     #: per-record host ops allowed between ParseExample and the enqueue —
     #: the image-decode pipelines of ``Session.scala:173-263``
@@ -302,9 +356,11 @@ class TFTrainingSession:
 
     def _walk_compute(self, output_names: Sequence[str]):
         """One ancestor walk of ``outputs``: (compute-node keep set,
-        dequeue nodes found).  Dequeues end the walk — the pipeline
-        behind them is interpreted host-side, not compiled."""
-        seen, dequeues = set(), []
+        dequeue nodes found, direct parse feeds found).  Dequeues AND
+        directly-consumed ParseExample nodes end the walk — the pipeline
+        behind them is interpreted host-side, not compiled (the
+        no-batching-queue reader pattern)."""
+        seen, dequeues, parse_feeds = set(), [], []
         stack = [_split_ref(o)[0] for o in output_names]
         while stack:
             name = stack.pop()
@@ -317,9 +373,17 @@ class TFTrainingSession:
                 if name not in dequeues:
                     dequeues.append(name)
                 continue
+            if node["op"] in _PARSE_OPS:
+                if name not in parse_feeds:
+                    parse_feeds.append(name)
+                continue
             seen.add(name)
             stack.extend(_split_ref(i)[0] for i in node["inputs"])
-        return seen, dequeues
+        # deterministic graph order, not DFS-stack order
+        order = {n["name"]: i for i, n in enumerate(self.nodes)}
+        dequeues.sort(key=lambda n: order.get(n, 0))
+        parse_feeds.sort(key=lambda n: order.get(n, 0))
+        return seen, dequeues, parse_feeds
 
     # -- dataset construction ---------------------------------------------
     def _records(self, filenames: List[str], comps
@@ -358,25 +422,71 @@ class TFTrainingSession:
                 out.append(tuple(row))
         return out
 
+    def _parse_feed_records(self, parse_name: str):
+        """Direct (non-queue) reader pattern: the compute graph consumes
+        ParseExample outputs with no batching queue between — interpret
+        the parse node itself as the pipeline endpoint."""
+        pe = self.by_name[parse_name]
+        keys, dtypes, shapes, first_dense = self._dense_spec(pe)
+        comps = [(k, dtypes[i] if i < len(dtypes) else np.float32,
+                  list(shapes[i]) if i < len(shapes) else [], [])
+                 for i, k in enumerate(keys)]
+        files = self._serialized_source(pe)
+        return self._records(files, comps), comps, first_dense
+
     # -- the public API ----------------------------------------------------
     def build(self, output_names: Sequence[str], train_consts: bool = True):
         """Return (model, dataset_records, graph_component_indices,
-        label_component_indices)."""
-        keep, dequeues = self._walk_compute(output_names)
-        if len(dequeues) != 1:
-            raise NotImplementedError(
-                f"expected exactly one dequeue feeding the compute graph, "
-                f"found {dequeues}")
-        deq = dequeues[0]
-        filenames, comps = self.interpret_pipeline(deq)
-        records = self._records(filenames, comps)
+        label_component_indices).
 
-        # rewrite "deq:k" refs to synthetic input names "deq__k"
+        Input topologies handled (``Session.scala:173-263`` family):
+        one dequeue; several dequeues over ONE queue (the stream splits
+        round-robin between them — ``handleLocalDequeue``'s split);
+        dequeues over DIFFERENT queues (rows zip by index, e.g. a feature
+        queue + a label queue); several enqueues into one queue (streams
+        union); RandomShuffleQueue (host-side shuffle); and queue-less
+        graphs reading ParseExample directly."""
+        keep, dequeues, parse_feeds = self._walk_compute(output_names)
+        if not dequeues and not parse_feeds:
+            raise ValueError("no input pipeline (dequeue or ParseExample) "
+                             "feeds the requested outputs")
+
+        # one record stream per endpoint; same-queue dequeues share one
+        # stream split round-robin in dequeue order
+        streams = []  # (endpoint name, rows, n components, port offset)
+        by_queue: Dict[str, List[str]] = {}
+        for deq in dequeues:
+            qname = self._follow_identity(
+                self.by_name[deq]["inputs"][0])["name"]
+            by_queue.setdefault(qname, []).append(deq)
+        for qname, deqs in by_queue.items():
+            records, comps = self._dequeue_records(deqs[0])
+            k = len(deqs)
+            for j, d in enumerate(deqs):
+                rows = records[j::k] if k > 1 else records
+                streams.append((d, rows, len(comps), 0))
+        for pf in parse_feeds:
+            rows, comps, first_dense = self._parse_feed_records(pf)
+            streams.append((pf, rows, len(comps), first_dense))
+
+        # zip the streams: every endpoint advances once per sample row
+        n_rows = min(len(rows) for _, rows, _, _ in streams)
+        col_of = {}  # (endpoint, port) -> combined-row column
+        col = 0
+        for name, _rows, n_comps, off in streams:
+            for p in range(n_comps):
+                col_of[(name, off + p)] = col
+                col += 1
+        combined = [sum((tuple(rows[i]) for _, rows, _, _ in streams), ())
+                    for i in range(n_rows)]
+
+        endpoints = {name for name, *_ in streams}
+
         def rewrite(ref: str) -> str:
             name, port = _split_ref(ref)
-            return f"{name}__{port}" if name == deq else ref
+            return f"{name}__{port}" if name in endpoints else ref
 
-        used_ports = set()
+        used = set()
         compute_nodes = []
         for n in self.nodes:
             if n["name"] not in keep:
@@ -388,15 +498,16 @@ class TFTrainingSession:
                 if i.startswith("^"):  # control dep: not a data port
                     continue
                 nm, port = _split_ref(i)
-                if nm == deq:
-                    used_ports.add(port)
+                if nm in endpoints:
+                    used.add((nm, port))
             compute_nodes.append(n2)
-        graph_ports = sorted(used_ports)
-        label_ports = [p for p in range(len(comps)) if p not in used_ports]
+        graph_keys = sorted(used, key=lambda kp: col_of[kp])
+        graph_ports = [col_of[kp] for kp in graph_keys]
+        label_ports = [c for c in range(col) if c not in graph_ports]
         loader = TensorflowLoader(
-            compute_nodes, [f"{deq}__{p}" for p in graph_ports],
+            compute_nodes, [f"{nm}__{p}" for nm, p in graph_keys],
             list(output_names), train_consts=train_consts)
-        return loader.load(), records, graph_ports, label_ports
+        return loader.load(), combined, graph_ports, label_ports
 
     def _compute_closure(self, output_names, deq):
         seen = set()

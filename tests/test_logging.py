@@ -63,6 +63,18 @@ def test_redirect_log_file_knob_and_no_thirdparty(tmp_path):
     assert "still filed" in content
 
 
+def test_no_duplicate_framework_lines(tmp_path, capsys):
+    """A child framework logger that self-installed a fallback handler
+    before the redirect (bigdl_tpu.optim does) must not emit twice."""
+    import bigdl_tpu.optim  # noqa: F401 — installs its fallback handler
+
+    blog.redirect_thirdparty_logs(str(tmp_path / "bigdl.log"))
+    lg = logging.getLogger("bigdl_tpu.optim")
+    lg.info("once-only progress line")
+    cap = capsys.readouterr()
+    assert (cap.out + cap.err).count("once-only progress line") == 1
+
+
 def test_redirect_idempotent(tmp_path, capsys):
     log_file = str(tmp_path / "bigdl.log")
     blog.redirect_thirdparty_logs(log_file)

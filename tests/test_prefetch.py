@@ -1,0 +1,140 @@
+"""Async input prefetch (VERDICT r3 item 4; reference capability
+``dataset/image/MTLabeledBGRImgToBatch.scala:31``): the Optimizer loop
+must overlap host transform + h2d with the device step, without changing
+training semantics."""
+
+import time
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.nn.module import state_dict
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils.config import BigDLConfig, set_config
+
+
+def teardown_function(_fn):
+    set_config(None)
+
+
+def _make_data(n=64, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    return [Sample(x[i], np.int64(y[i])) for i in range(n)]
+
+
+def _mlp(dim=4, width=16, seed=42):
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(seed)
+    return nn.Sequential(nn.Linear(dim, width), nn.Tanh(),
+                         nn.Linear(width, 2), nn.LogSoftMax())
+
+
+def _train(prefetch: int, seed=7, iters=12):
+    set_config(BigDLConfig(prefetch_batches=prefetch))
+    from bigdl_tpu.utils.rng import RNG
+
+    samples = _make_data()
+    m = _mlp(seed=seed)
+    RNG.set_seed(99)  # data shuffling + dropout keys identical per run
+    o = optim.LocalOptimizer(m, samples, nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(iters))
+    o.set_optim_method(optim.SGD(learning_rate=0.5, momentum=0.9))
+    o.optimize()
+    return {k: np.asarray(v) for k, v in state_dict(m).items()}, o.metrics
+
+
+def test_prefetch_matches_sync_trajectory():
+    """Double-buffered input must reproduce the synchronous trajectory
+    bit-for-bit in expectation (same batches, same keys, same updates)."""
+    p_params, p_metrics = _train(prefetch=2)
+    s_params, s_metrics = _train(prefetch=0)
+    for k in s_params:
+        np.testing.assert_allclose(p_params[k], s_params[k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    # both paths record the full stage set
+    for m in (p_metrics, s_metrics):
+        for want in ("data time", "host to device time", "dispatch time",
+                     "computing time"):
+            assert want in m.stages(), (want, m.stages())
+
+
+class SlowTransform(Transformer):
+    """Host-side transform with a fixed per-batch cost (stands in for
+    JPEG decode + augmentation)."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def apply(self, it):
+        for batch in it:
+            time.sleep(self.delay_s)
+            yield batch
+
+
+def test_prefetch_hides_slow_input():
+    """With a device step at least as long as the host transform, the
+    transform must vanish from the driver's data-wait stage (the VERDICT
+    'data-wait ~ 0' artifact condition)."""
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+    delay, iters = 0.05, 6
+    rng = np.random.default_rng(3)
+    dim, width = 256, 1024  # heavy enough that a CPU step >> delay
+    samples = [Sample(rng.normal(size=(dim,)).astype(np.float32),
+                      np.int64(i % 2)) for i in range(64)]
+
+    def run(prefetch):
+        set_config(BigDLConfig(prefetch_batches=prefetch))
+        ds = DataSet.array(samples).transform(
+            SampleToMiniBatch(32)).transform(SlowTransform(delay))
+        o = optim.LocalOptimizer(_mlp(dim=dim, width=width, seed=5), ds,
+                                 nn.ClassNLLCriterion(), batch_size=32,
+                                 end_trigger=Trigger.max_iteration(iters))
+        o.set_optim_method(optim.SGD(learning_rate=0.1))
+        o.optimize()
+        # drop the first sample: it pays compile (sync) or pipe-fill
+        # (prefetch) either way
+        waits = [w for w in o.metrics._scalars["data time"]][1:]
+        return sum(waits) / len(waits)
+
+    sync_wait = run(0)
+    prefetch_wait = run(2)
+    # sync pays the full delay per iteration; overlapped wait must drop
+    # by well over half (generous margins for CI noise)
+    assert sync_wait > 0.8 * delay, sync_wait
+    assert prefetch_wait < 0.5 * sync_wait, (prefetch_wait, sync_wait)
+
+
+def test_prefetch_surfaces_producer_errors():
+    """A failure inside the input pipeline must reach the retry loop like
+    a compute failure, not hang the driver."""
+    class Boom(Transformer):
+        def apply(self, it):
+            for i, batch in enumerate(it):
+                if i == 2:
+                    raise RuntimeError("injected input failure")
+                yield batch
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+    set_config(BigDLConfig(prefetch_batches=2, failure_retry_times=1,
+                           failure_retry_interval=60.0))
+    ds = DataSet.array(_make_data()).transform(
+        SampleToMiniBatch(16)).transform(Boom())
+    o = optim.LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(10))
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    import pytest
+
+    with pytest.raises(RuntimeError, match="injected input failure"):
+        o.optimize()
