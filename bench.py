@@ -239,9 +239,30 @@ def main():
         "vs_baseline": None,
         "mfu": head.get("mfu"),
         "device": jax.devices()[0].device_kind,
+        "source": _source_state(),
         "configs": results,
     }
     print(json.dumps(line))
+
+
+def _source_state():
+    """Commit + dirty flag of the tree that produced the number — a bench
+    artifact certifies nothing unless it names the exact source state (the
+    round-2 maxpool regression hid for a full round because the committed
+    tree diverged from the benched tree)."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=here, capture_output=True, text=True,
+                             timeout=10).stdout.strip()
+        dirty = subprocess.run(["git", "status", "--porcelain"],
+                               cwd=here, capture_output=True, text=True,
+                               timeout=10).stdout.strip()
+        return {"commit": rev or None, "dirty": bool(dirty)}
+    except Exception:
+        return {"commit": None, "dirty": None}
 
 
 if __name__ == "__main__":
