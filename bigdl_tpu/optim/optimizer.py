@@ -34,6 +34,7 @@ from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.parallel.train_step import EvalStep, TrainStep
+from bigdl_tpu.utils import file as File
 from bigdl_tpu.utils.config import get_config
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.rng import RNG
@@ -273,8 +274,10 @@ class Optimizer:
             self._ckpt_dir = self._ckpt_path
         else:
             stamp = datetime.now().strftime("%Y%m%d_%H%M%S")
-            self._ckpt_dir = os.path.join(self._ckpt_path, stamp)
-        os.makedirs(self._ckpt_dir, exist_ok=True)
+            self._ckpt_dir = self._ckpt_path.rstrip("/") + "/" + stamp \
+                if File.is_remote(self._ckpt_path) \
+                else os.path.join(self._ckpt_path, stamp)
+        File.makedirs(self._ckpt_dir)
 
     def _save_checkpoint(self, step: TrainStep):
         if self._checkpoint_dir() is None:
@@ -298,16 +301,16 @@ class Optimizer:
 
     @staticmethod
     def get_latest_file(path: str, prefix: str) -> Optional[str]:
-        """(``DistriOptimizer.scala:868-885``)."""
-        if not os.path.isdir(path):
-            return None
+        """(``DistriOptimizer.scala:868-885``); local or remote
+        (``gs://...``) checkpoint directories."""
         best, best_n = None, -1
         pat = re.compile(re.escape(prefix) + r"\.(\d+)$")
-        for f in os.listdir(path):
+        for f in File.listdir(path):
             m = pat.match(f)
             if m and int(m.group(1)) > best_n:
                 best_n = int(m.group(1))
-                best = os.path.join(path, f)
+                best = path.rstrip("/") + "/" + f if File.is_remote(path) \
+                    else os.path.join(path, f)
         return best
 
     def _restore_latest(self) -> bool:

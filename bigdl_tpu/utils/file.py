@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 import tempfile
 
-__all__ = ["save", "load", "is_remote"]
+__all__ = ["save", "load", "is_remote", "makedirs", "listdir"]
 
 
 def is_remote(path: str) -> bool:
@@ -64,7 +64,51 @@ def load(path: str) -> bytes:
         return f.read()
 
 
+def _fs(path: str):
+    import fsspec  # type: ignore
+
+    fs, rel = fsspec.core.url_to_fs(path)
+    return fs, rel
+
+
+def makedirs(path: str):
+    """Directory creation that also understands remote schemes (object
+    stores treat directories as prefixes; mkdirs is a no-op there but
+    validates the scheme/credentials early — ``File.scala:67-171``
+    resolves the Hadoop FileSystem the same way)."""
+    if is_remote(path):
+        try:
+            fs, rel = _fs(path)
+            fs.makedirs(rel, exist_ok=True)
+        except ImportError as e:
+            raise RuntimeError(
+                f"remote path {path!r} requires fsspec which is not "
+                f"installed in this environment") from e
+        return
+    os.makedirs(path, exist_ok=True)
+
+
+def listdir(path: str):
+    """Base names under a local or remote directory ([] when absent)."""
+    if is_remote(path):
+        try:
+            fs, rel = _fs(path)
+        except ImportError:
+            return []
+        if not fs.exists(rel):
+            return []
+        return [p.rstrip("/").rsplit("/", 1)[-1]
+                for p in fs.ls(rel, detail=False)]
+    if not os.path.isdir(path):
+        return []
+    return os.listdir(path)
+
+
 def _exists(path: str) -> bool:
     if is_remote(path):
-        return False
+        try:
+            fs, rel = _fs(path)
+            return fs.exists(rel)
+        except Exception:
+            return False
     return os.path.exists(path)
