@@ -240,6 +240,12 @@ def main():
         "mfu": head.get("mfu"),
         "device": jax.devices()[0].device_kind,
         "source": _source_state(),
+        # the reference publishes no numbers (BASELINE.md) so vs_baseline
+        # stays None; track progress against our own best measured round
+        # number instead (round 3: 4,853 img/s Inception-v1, BASELINE.md)
+        "vs_round3_best": (round(head["images_per_sec"] / 4853.0, 3)
+                           if head_name == HEADLINE
+                           and head.get("images_per_sec") else None),
         "configs": results,
     }
     print(json.dumps(line))

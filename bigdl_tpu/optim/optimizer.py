@@ -279,10 +279,21 @@ class Optimizer:
                 else os.path.join(self._ckpt_path, stamp)
         File.makedirs(self._ckpt_dir)
 
+    def _join_checkpoint_write(self):
+        """Block until the in-flight async checkpoint write (if any) has
+        landed — called before restores, before the next checkpoint, and
+        at run end, so a reader can never observe a half-written file
+        set."""
+        fut = getattr(self, "_ckpt_future", None)
+        if fut is not None:
+            with self.metrics.timer("checkpoint wait time"):
+                fut.result()
+            self._ckpt_future = None
+
     def _save_checkpoint(self, step: TrainStep):
         if self._checkpoint_dir() is None:
             return
-        from bigdl_tpu.utils.serializer import save_module, save_optim_method
+        from bigdl_tpu.utils.module_format import dumps
 
         # every process participates in the gathers (collectives on a
         # multi-host mesh); only the coordinator writes files —
@@ -294,10 +305,29 @@ class Optimizer:
             np.asarray, step.gather_replicated(step.opt_state))
         if not Engine.is_coordinator():
             return
-        save_module(self.model, os.path.join(self._ckpt_dir, f"model.{n}"), overwrite=True)
-        save_optim_method(self.optim_method,
-                          os.path.join(self._ckpt_dir, f"optimMethod.{n}"), overwrite=True)
-        log.info(f"[Checkpoint] saved model.{n} / optimMethod.{n} to {self._ckpt_dir}")
+        # snapshot to bytes NOW (consistent state); the IO can overlap
+        # with the next training iterations (BIGDL_ASYNC_CHECKPOINT)
+        self._join_checkpoint_write()
+        blobs = [(dumps(self.model, kind="module"),
+                  os.path.join(self._ckpt_dir, f"model.{n}")),
+                 (dumps(self.optim_method, kind="optim"),
+                  os.path.join(self._ckpt_dir, f"optimMethod.{n}"))]
+
+        def write():
+            for blob, path in blobs:
+                File.save(blob, path, overwrite=True)
+            log.info(f"[Checkpoint] saved model.{n} / optimMethod.{n} "
+                     f"to {self._ckpt_dir}")
+
+        if get_config().async_checkpoint:
+            from concurrent.futures import ThreadPoolExecutor
+
+            if getattr(self, "_ckpt_pool", None) is None:
+                self._ckpt_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="bigdl-ckpt")
+            self._ckpt_future = self._ckpt_pool.submit(write)
+        else:
+            write()
 
     @staticmethod
     def get_latest_file(path: str, prefix: str) -> Optional[str]:
@@ -317,6 +347,7 @@ class Optimizer:
         d = self._checkpoint_dir()
         if d is None:
             return False
+        self._join_checkpoint_write()
         mfile = self.get_latest_file(d, "model")
         ofile = self.get_latest_file(d, "optimMethod")
         if mfile is None or ofile is None:
@@ -581,6 +612,7 @@ class Optimizer:
                 jax.profiler.stop_trace()
                 log.info(f"[Optimizer] profiler trace in {profile_dir}")
         step.sync_to_model()
+        self._join_checkpoint_write()  # run ends with all writes landed
         log.info(self.metrics.summary())
         return self.model
 

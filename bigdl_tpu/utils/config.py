@@ -29,6 +29,7 @@ test (or an embedding application) can inject overrides with
 | log_file               | BIGDL_LOG_FILE              | utils.logging redirect target |
 | log_thirdparty         | BIGDL_LOG_THIRDPARTY        | redirect third-party logs to file |
 | prefetch_batches       | BIGDL_PREFETCH              | Optimizer input double-buffering depth (0 = sync) |
+| async_checkpoint       | BIGDL_ASYNC_CHECKPOINT      | overlap checkpoint IO with training (default on) |
 """
 
 from __future__ import annotations
@@ -71,6 +72,8 @@ class BigDLConfig:
     log_thirdparty: bool = True
     # input pipeline: batches to transform+transfer ahead of the device
     prefetch_batches: int = 2
+    # overlap checkpoint byte-writes with the next training iterations
+    async_checkpoint: bool = True
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -101,6 +104,8 @@ class BigDLConfig:
             log_file=env.get("BIGDL_LOG_FILE") or None,
             log_thirdparty=_truthy(env.get("BIGDL_LOG_THIRDPARTY") or "true"),
             prefetch_batches=_int("BIGDL_PREFETCH", 2),
+            async_checkpoint=_truthy(
+                env.get("BIGDL_ASYNC_CHECKPOINT") or "true"),
         )
 
 

@@ -384,3 +384,47 @@ def test_straggler_auto_budget_arms_after_samples(monkeypatch):
     assert o._straggler_timeout() == pytest.approx(300.0)
     monkeypatch.setenv("BIGDL_ITERATION_TIMEOUT", "0")
     assert o._straggler_timeout() is None
+
+
+def test_async_checkpoint_overlaps_and_lands(tmp_path, monkeypatch):
+    """Checkpoint byte-writes overlap training (BIGDL_ASYNC_CHECKPOINT
+    default); a slow writer must not lose or tear the file set — the run
+    joins in-flight writes before restores and at the end."""
+    import time as _time
+
+    from bigdl_tpu.utils import file as File
+    from bigdl_tpu.utils.serializer import load_module, load_optim_method
+
+    real_save = File.save
+
+    def slow_save(data, path, overwrite=False):
+        _time.sleep(0.05)
+        return real_save(data, path, overwrite)
+
+    monkeypatch.setattr(File, "save", slow_save)
+    # optimizer.py binds the module, not the function — patch its ref too
+    import bigdl_tpu.optim.optimizer as opt_mod
+
+    monkeypatch.setattr(opt_mod.File, "save", slow_save)
+
+    samples, _, _ = _make_data()
+    m = _mlp(seed=17)
+    o = optim.LocalOptimizer(m, samples, nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(6))
+    o.set_optim_method(optim.Adam(learning_rate=0.01))
+    o.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+    o.overwrite_checkpoint()
+    o.optimize()
+
+    mfile = optim.Optimizer.get_latest_file(str(tmp_path), "model")
+    ofile = optim.Optimizer.get_latest_file(str(tmp_path), "optimMethod")
+    assert mfile and mfile.endswith("model.6")
+    m2 = load_module(mfile)  # loads => the write fully landed
+    om2 = load_optim_method(ofile)
+    assert om2.state["driver_state"]["neval"] == 6
+    p1, p2 = state_dict(m), state_dict(m2)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=1e-6)
+    assert "checkpoint wait time" in o.metrics.stages()
