@@ -1,0 +1,179 @@
+"""Post-training int8 quantization — the reference's **bigquant**
+capability (`spark/dl/pom.xml:85-90` declares `bigquant-java`/`
+bigquant-native`; upstream the Scala tree has no call sites, so the
+semantics here follow the bigquant library itself: symmetric int8
+weights with per-output-channel scales, dynamic per-tensor activation
+quantization, int32 accumulation, float dequantized output) — rebuilt
+TPU-native:
+
+- the int8 x int8 -> int32 contraction runs on the MXU at TWICE the
+  bf16 macs/cycle on v5e (394 int8 TOPS vs 197 bf16 TFLOP/s), so
+  quantized inference is a throughput feature, not just a memory one;
+- weights store as int8 buffers (4x smaller than f32 in BTPU
+  checkpoints and in HBM);
+- `quantize(model)` mirrors `Module.quantize()` in the reference's API
+  surface: walk the tree, swap eligible layers for their quantized
+  twins, return the model in eval mode.
+
+Quantized modules are inference-only (like bigquant): they carry no
+trainable parameters, so `state_dict(kind="param")` is empty and the
+training step refuses them naturally.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.layers.conv import SpatialConvolution
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.module import Container, Module
+
+__all__ = ["QuantizedLinear", "QuantizedSpatialConvolution", "quantize"]
+
+
+def _quantize_weight(w: np.ndarray, reduce_axes: Tuple[int, ...]):
+    """Symmetric per-output-channel int8: scale = max|w| / 127 over all
+    non-output axes (bigquant's FLOAT->int8 kernel convention)."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale.reshape(-1)
+
+
+def _quantize_activation(x, axes=None):
+    """Dynamic per-tensor symmetric int8 for activations: returns
+    (x_q int8, scale f32 scalar).  Differentiation is unsupported by
+    design (inference path)."""
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedLinear(Module):
+    """int8 ``y = x W^T + b`` (``Linear`` twin).  The contraction is
+    int8 x int8 -> int32 (``preferred_element_type``), dequantized by
+    ``act_scale * w_scale[out]``."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 weight_q=None, w_scale=None, bias=None):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = bias is not None
+        self.register_buffer("weight_q",
+                             np.zeros((output_size, input_size), np.int8)
+                             if weight_q is None else np.asarray(weight_q))
+        self.register_buffer("w_scale",
+                             np.ones(output_size, np.float32)
+                             if w_scale is None else np.asarray(w_scale))
+        if bias is not None:
+            self.register_buffer("bias", np.asarray(bias, np.float32))
+
+    @classmethod
+    def from_float(cls, m: Linear) -> "QuantizedLinear":
+        q, scale = _quantize_weight(np.asarray(m.weight), (1,))
+        bias = np.asarray(m.bias) if m.with_bias else None
+        out = cls(m.input_size, m.output_size, q, scale, bias)
+        if m.__dict__.get("_name"):
+            out.set_name(m.__dict__["_name"])
+        return out
+
+    def update_output(self, input):
+        x_q, s_x = _quantize_activation(input)
+        acc = lax.dot_general(
+            x_q, self.weight_q,
+            dimension_numbers=(((x_q.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (s_x * self.w_scale)
+        if self.with_bias:
+            y = y + self.bias
+        return y
+
+
+class QuantizedSpatialConvolution(Module):
+    """int8 NCHW convolution (``SpatialConvolution`` twin); weight
+    stays OIHW int8, accumulation int32 on the MXU."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1,
+                 stride_h: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, weight_q=None, w_scale=None, bias=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.with_bias = bias is not None
+        wshape = (n_output_plane, n_input_plane // n_group,
+                  kernel_h, kernel_w)
+        self.register_buffer("weight_q",
+                             np.zeros(wshape, np.int8) if weight_q is None
+                             else np.asarray(weight_q))
+        self.register_buffer("w_scale",
+                             np.ones(n_output_plane, np.float32)
+                             if w_scale is None else np.asarray(w_scale))
+        if bias is not None:
+            self.register_buffer("bias", np.asarray(bias, np.float32))
+
+    @classmethod
+    def from_float(cls, m: SpatialConvolution) -> "QuantizedSpatialConvolution":
+        if m.format != "NCHW":
+            raise ValueError("quantize supports NCHW convolutions")
+        q, scale = _quantize_weight(np.asarray(m.weight), (1, 2, 3))
+        bias = np.asarray(m.bias) if m.with_bias else None
+        out = cls(m.n_input_plane, m.n_output_plane, m.kernel_w, m.kernel_h,
+                  m.stride_w, m.stride_h, m.pad_w, m.pad_h, m.n_group,
+                  q, scale, bias)
+        if m.__dict__.get("_name"):
+            out.set_name(m.__dict__["_name"])
+        return out
+
+    def update_output(self, input):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        x_q, s_x = _quantize_activation(x)
+        if self.pad_w == -1 or self.pad_h == -1:
+            padding = "SAME"
+        else:
+            padding = [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+        dn = lax.conv_dimension_numbers(
+            x.shape, self.weight_q.shape, ("NCHW", "OIHW", "NCHW"))
+        acc = lax.conv_general_dilated(
+            x_q, self.weight_q, (self.stride_h, self.stride_w), padding,
+            dimension_numbers=dn, feature_group_count=self.n_group,
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) \
+            * (s_x * self.w_scale)[None, :, None, None]
+        if self.with_bias:
+            y = y + self.bias[None, :, None, None]
+        return y[0] if squeeze else y
+
+
+_QUANTIZABLE = {Linear: QuantizedLinear.from_float,
+                SpatialConvolution: QuantizedSpatialConvolution.from_float}
+
+
+def quantize(model: Module) -> Module:
+    """Swap every eligible layer for its int8 twin (in place for
+    containers; returns the — possibly new — root) and switch to eval
+    mode: the reference API's ``quantized_model = model.quantize()``."""
+    conv = _QUANTIZABLE.get(type(model))
+    if conv is not None:
+        return conv(model)
+    if isinstance(model, Container):
+        mods = model.__dict__["_modules"]
+        for k in list(mods):
+            mods[k] = quantize(mods[k])
+    else:
+        for k, sub in list(model.__dict__["_modules"].items()):
+            model.__dict__["_modules"][k] = quantize(sub)
+    return model.evaluate()

@@ -1,0 +1,105 @@
+"""int8 post-training quantization (the reference's bigquant capability,
+``spark/dl/pom.xml:85-90``): QuantizedLinear / QuantizedSpatialConvolution
+numeric closeness to their float twins, quantize() tree walk, BTPU
+round-trip, and int8 dtype discipline."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import state_dict
+from bigdl_tpu.nn.quantized import (QuantizedLinear,
+                                    QuantizedSpatialConvolution, quantize)
+from bigdl_tpu.utils.rng import RNG
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    return np.abs(a - b).max() / (np.abs(b).max() + 1e-12)
+
+
+def test_quantized_linear_close_to_float():
+    RNG.set_seed(40)
+    m = nn.Linear(64, 32)
+    x = np.random.RandomState(0).randn(16, 64).astype(np.float32)
+    want = np.asarray(m.evaluate().forward(x))
+    q = QuantizedLinear.from_float(m)
+    got = np.asarray(q.forward(x))
+    # int8 symmetric quantization: ~1% relative error at these shapes
+    assert _rel_err(got, want) < 0.02, _rel_err(got, want)
+    assert np.asarray(q.weight_q).dtype == np.int8
+    assert state_dict(q, kind="param") == {}  # inference-only
+
+
+def test_quantized_conv_close_to_float():
+    RNG.set_seed(41)
+    m = nn.SpatialConvolution(8, 16, 3, 3, 2, 2, 1, 1)
+    x = np.random.RandomState(1).randn(4, 8, 14, 14).astype(np.float32)
+    want = np.asarray(m.evaluate().forward(x))
+    q = QuantizedSpatialConvolution.from_float(m)
+    got = np.asarray(q.forward(x))
+    assert got.shape == want.shape
+    assert _rel_err(got, want) < 0.03, _rel_err(got, want)
+
+
+def test_quantized_grouped_and_same_pad_conv():
+    RNG.set_seed(42)
+    m = nn.SpatialConvolution(8, 16, 3, 3, 1, 1, -1, -1, n_group=4)
+    x = np.random.RandomState(2).randn(2, 8, 10, 10).astype(np.float32)
+    want = np.asarray(m.evaluate().forward(x))
+    got = np.asarray(QuantizedSpatialConvolution.from_float(m).forward(x))
+    assert got.shape == want.shape
+    assert _rel_err(got, want) < 0.03
+
+
+def test_quantize_walk_preserves_model_accuracy():
+    """quantize(model) on a trained classifier: predictions match the
+    float model on nearly every sample (the bigquant acceptance bar)."""
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.dataset.sample import Sample
+
+    RNG.set_seed(43)
+    rng = np.random.RandomState(3)
+    x = rng.randn(128, 8).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+    samples = [Sample(x[i], y[i]) for i in range(128)]
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                          nn.Linear(32, 2), nn.LogSoftMax())
+    o = optim.LocalOptimizer(model, samples, nn.ClassNLLCriterion(),
+                             batch_size=32,
+                             end_trigger=optim.Trigger.max_epoch(10))
+    o.set_optim_method(optim.SGD(learning_rate=0.5))
+    o.optimize()
+    float_pred = np.asarray(model.evaluate().forward(x)).argmax(1)
+
+    qmodel = quantize(model)
+    assert isinstance(qmodel.get(0), QuantizedLinear)
+    assert isinstance(qmodel.get(2), QuantizedLinear)
+    q_pred = np.asarray(qmodel.forward(x)).argmax(1)
+    assert (q_pred == float_pred).mean() >= 0.98
+
+
+def test_quantized_btpu_roundtrip(tmp_path):
+    from bigdl_tpu.utils.serializer import load_module, save_module
+
+    RNG.set_seed(44)
+    model = quantize(nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        nn.ReLU(), nn.Reshape([4 * 6 * 6]), nn.Linear(4 * 6 * 6, 5)))
+    x = np.random.RandomState(4).randn(2, 3, 6, 6).astype(np.float32)
+    want = np.asarray(model.forward(x))
+    path = str(tmp_path / "q.btpu")
+    save_module(model, path)
+    back = load_module(path)
+    assert np.asarray(back.get(0).weight_q).dtype == np.int8
+    np.testing.assert_allclose(np.asarray(back.evaluate().forward(x)),
+                               want, rtol=1e-6, atol=1e-6)
+
+
+def test_quantized_weight_memory_shrinks():
+    RNG.set_seed(45)
+    m = nn.Linear(256, 256)
+    q = QuantizedLinear.from_float(m)
+    fbytes = np.asarray(m.weight).nbytes
+    qbytes = np.asarray(q.weight_q).nbytes + np.asarray(q.w_scale).nbytes
+    assert qbytes < fbytes / 3.5  # ~4x smaller
