@@ -119,6 +119,8 @@ def main(argv=None):
     p.add_argument("--tfOutput", default=None)
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--meanFile", default=None)
+    p.add_argument("--quantize", action="store_true",
+                   help="evaluate the int8-quantized model (bigquant)")
     args = p.parse_args(argv)
 
     from bigdl_tpu.utils.engine import honor_platform_request
@@ -127,6 +129,10 @@ def main(argv=None):
 
     model = load_model(args.modelType, args.modelPath, args.caffeDefPath,
                        args.tfInput, args.tfOutput)
+    if args.quantize:
+        from bigdl_tpu.nn.quantized import quantize
+
+        model = quantize(model)
     samples = load_validation_samples(args.folder, args.meanFile)
     scores = validate(model, samples, args.batchSize)
     for name, value in scores.items():

@@ -58,3 +58,11 @@ def test_treelstm_sentiment_learns(tmp_path, monkeypatch):
     # end clearly above chance (2 classes)
     assert after > 0.7, (before, after)
     assert after > before - 0.05
+
+
+def test_tensorflow_interop_roundtrip_and_finetune(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from examples.tensorflow_interop import main
+
+    acc = main(["--modelPath", str(tmp_path / "m.pb")])
+    assert acc > 0.8, acc

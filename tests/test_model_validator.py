@@ -103,6 +103,21 @@ def test_cli_end_to_end_npz(trained_cnn, tmp_path, capsys):
     assert 0.0 <= scores["Top1Accuracy"] <= 1.0
 
 
+def test_quantized_validation(trained_cnn, val_folder, tmp_path):
+    """--quantize evaluates the int8 model; Top-1 stays within a few
+    points of float (bigquant acceptance bar)."""
+    samples = load_validation_samples(val_folder)
+    fmts = _save_all_formats(trained_cnn, str(tmp_path))
+    model = load_model("bigdl", **fmts["bigdl"])
+    float_scores = validate(model, samples, batch_size=16)
+    from bigdl_tpu.nn.quantized import quantize
+
+    q_scores = validate(quantize(load_model("bigdl", **fmts["bigdl"])),
+                        samples, batch_size=16)
+    assert abs(q_scores["Top1Accuracy"]
+               - float_scores["Top1Accuracy"]) <= 0.1
+
+
 def test_mean_file_subtraction(trained_cnn, val_folder, tmp_path):
     mean = np.full((3, 8, 8), 0.5, np.float32)
     mean_path = str(tmp_path / "mean.npy")
