@@ -48,14 +48,18 @@ def build_transformer_lm(vocab_size: int, num_layers: int = 4,
                          max_len: int = 1024, mlp_ratio: int = 4,
                          dropout: float = 0.0, backend="auto",
                          sp_mesh=None, sp_axis: str = "seq",
-                         sp_strategy: str = "ring") -> nn.Module:
-    """Causal decoder-only LM over [batch, seq] token ids."""
+                         sp_strategy: str = "ring",
+                         sp_batch_axis=None) -> nn.Module:
+    """Causal decoder-only LM over [batch, seq] token ids.
+    ``sp_batch_axis`` composes sequence parallelism with data
+    parallelism on a 2-D (data, seq) mesh."""
     if sp_mesh is not None:
         from bigdl_tpu.parallel.sequence import (
             make_sequence_parallel_attention)
 
         backend = make_sequence_parallel_attention(
-            sp_mesh, strategy=sp_strategy, axis_name=sp_axis, causal=True)
+            sp_mesh, strategy=sp_strategy, axis_name=sp_axis, causal=True,
+            batch_axis=sp_batch_axis)
     model = nn.Sequential(
         nn.LookupTable(vocab_size, embed_dim),
         PositionalEmbedding(max_len, embed_dim),

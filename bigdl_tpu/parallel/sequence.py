@@ -120,10 +120,14 @@ def make_sequence_parallel_attention(mesh, strategy: str = "ring",
                                      axis_name: str = SEQ_AXIS,
                                      causal: bool = False,
                                      scale: Optional[float] = None,
-                                     use_flash: bool = False):
+                                     use_flash: bool = False,
+                                     batch_axis: Optional[str] = None):
     """shard_map-wrap ring/ulysses attention for global [B, H, S, D] arrays
-    sharded on ``axis_name`` over ``mesh``.  Batch stays replicated here;
-    compose with a data axis by extending the PartitionSpecs."""
+    sharded on ``axis_name`` over ``mesh``.  Pass ``batch_axis`` to
+    compose with data parallelism on a 2-D ``(data, seq)`` mesh: the
+    batch dim shards over ``batch_axis`` while each data-row runs its own
+    k/v ring over ``axis_name`` (ppermute is scoped per axis, so the
+    rings never cross data rows)."""
     try:
         from jax import shard_map
     except ImportError:  # older jax
@@ -131,7 +135,7 @@ def make_sequence_parallel_attention(mesh, strategy: str = "ring",
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis_name]
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
 
     if strategy == "ring":
         fn = partial(ring_attention, axis_name=axis_name, causal=causal,
