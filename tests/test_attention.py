@@ -80,9 +80,12 @@ def test_sequence_parallel_matches_dense(seq_mesh, strategy, causal):
 def test_data_x_seq_ring_matches_dense():
     """Ring attention composed with data parallelism on a (data, seq)
     mesh: batch shards over 'data', each data row runs its own k/v ring
-    over 'seq' — must equal dense attention on the global arrays."""
+    over 'seq' — forward AND gradients must equal dense attention on the
+    global arrays (TrainStep differentiates through this form)."""
     from jax.sharding import Mesh
 
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (virtual CPU mesh)")
     devs = np.array(jax.devices()[:8]).reshape(2, 4)
     mesh = Mesh(devs, ("data", "seq"))
     q, k, v = _rand_qkv(b=4, h=2, s=32, d=8, seed=6)
@@ -92,6 +95,15 @@ def test_data_x_seq_ring_matches_dense():
     out_ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
                                rtol=2e-5, atol=2e-5)
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dot_product_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_ring_attention_differentiable(seq_mesh):
