@@ -66,3 +66,16 @@ def test_tensorflow_interop_roundtrip_and_finetune(tmp_path, monkeypatch):
 
     acc = main(["--modelPath", str(tmp_path / "m.pb")])
     assert acc > 0.8, acc
+
+
+def test_tta_bench_protocol(tmp_path, monkeypatch):
+    """Time-to-accuracy harness (BASELINE third leg): reaches the target
+    on synthetic data and reports the protocol fields."""
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, "/root/repo/tools")
+    from tools.tta_bench import main
+
+    r = main(["--model", "lenet", "--target", "0.9", "-b", "64",
+              "--max-epoch", "6"])
+    assert r["reached"] and r["final_top1"] >= 0.9
+    assert r["value"] > 0 and r["iterations"] > 0
