@@ -1,0 +1,28 @@
+"""Batch sweep for a bench config on the real chip (run when TPU is back):
+times the committed train step at several batch sizes in one process."""
+import sys, time
+sys.path.insert(0, '/root/repo')
+import jax, jax.numpy as jnp
+import bench
+
+ITERS = 16
+config = sys.argv[1] if len(sys.argv) > 1 else "inception_v1_imagenet"
+batches = [int(b) for b in (sys.argv[2].split(",") if len(sys.argv) > 2
+                            else ["192", "256", "384", "512"])]
+
+for b in batches:
+    try:
+        step, x, y = bench.make_step(config, b)
+        step.aot_scan(x, y, jax.random.key(0), ITERS)
+        losses = step.run_scan(x, y, jax.random.key(1), ITERS)
+        assert bool(jnp.isfinite(losses).all())
+        drain = bench.make_drain(step)
+        drain()
+        t0 = time.perf_counter()
+        step.run_scan(x, y, jax.random.key(2), ITERS)
+        drain()
+        wall = time.perf_counter() - t0
+        print(f"{config} b{b}: {b*ITERS/wall:,.0f} img/s "
+              f"({wall/ITERS*1e3:.1f} ms/step)", flush=True)
+    except Exception as e:
+        print(f"{config} b{b}: FAILED {type(e).__name__}: {e}", flush=True)

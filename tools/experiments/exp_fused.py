@@ -1,0 +1,36 @@
+import sys, time
+sys.path.insert(0, '/root/repo')
+import jax, jax.numpy as jnp, numpy as np
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.nn.fuse import optimize_for_tpu
+from bigdl_tpu.models.inception import build_inception_v1
+from bigdl_tpu.parallel.train_step import TrainStep
+from bigdl_tpu.utils.rng import RNG
+
+ITERS = 16
+rng = np.random.default_rng(0)
+
+def run(tag, fused, batch=256):
+    RNG.set_seed(0)
+    model = build_inception_v1(1000)
+    if fused:
+        model = optimize_for_tpu(model)
+    step = TrainStep(model, nn.ClassNLLCriterion(),
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+    x = jnp.asarray(rng.normal(size=(batch, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, batch))
+    step.aot_scan(x, y, jax.random.key(0), ITERS)
+    losses = step.run_scan(x, y, jax.random.key(1), ITERS)
+    assert bool(jnp.isfinite(losses).all())
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    t0 = time.perf_counter()
+    step.run_scan(x, y, jax.random.key(2), ITERS)
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    wall = time.perf_counter() - t0
+    print(f"{tag}: {batch*ITERS/wall:,.0f} img/s  ({wall/ITERS*1e3:.1f} ms/step)", flush=True)
+
+run("relu-outgrad only", False)
+run("relu-outgrad + fused-1x1", True)
+run("relu-outgrad + fused-1x1 b512", True, 512)

@@ -1,0 +1,40 @@
+"""Timing experiment: Inception-v1 train step, NCHW vs NHWC, batch 256/512."""
+import sys, time
+sys.path.insert(0, '/root/repo')
+import jax, jax.numpy as jnp, numpy as np
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.models.inception import build_inception_v1
+from bigdl_tpu.parallel.train_step import TrainStep
+from bigdl_tpu.utils.rng import RNG
+
+ITERS = 16
+rng = np.random.default_rng(0)
+
+def run(fmt, batch):
+    RNG.set_seed(0)
+    model = build_inception_v1(1000, format=fmt)
+    step = TrainStep(model, nn.ClassNLLCriterion(),
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+    shape = (batch, 3, 224, 224) if fmt == "NCHW" else (batch, 224, 224, 3)
+    x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, batch))
+    cost = step.aot_scan(x, y, jax.random.key(0), ITERS)
+    losses = step.run_scan(x, y, jax.random.key(1), ITERS)
+    assert bool(jnp.isfinite(losses).all())
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    t0 = time.perf_counter()
+    step.run_scan(x, y, jax.random.key(2), ITERS)
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    wall = time.perf_counter() - t0
+    rate = batch * ITERS / wall
+    print(f"{fmt} b{batch}: {rate:,.0f} img/s  ({wall/ITERS*1e3:.1f} ms/step)",
+          flush=True)
+
+for fmt in ("NCHW", "NHWC"):
+    for batch in (256, 512):
+        try:
+            run(fmt, batch)
+        except Exception as e:
+            print(f"{fmt} b{batch}: FAILED {type(e).__name__}: {e}", flush=True)
