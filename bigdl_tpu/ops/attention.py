@@ -424,7 +424,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Flash attention (Pallas TPU kernel).  [B, H, S, D] in/out.
 
@@ -432,7 +433,18 @@ def flash_attention(q, k, v, causal: bool = False,
     blocks; backward recomputes p from the saved logsumexp (no S x S
     materialization).  Off-TPU the kernels run in Pallas interpret mode so
     the identical code path is testable on the CPU mesh.
+
+    Block sizes default to 128/128; ``BIGDL_FLASH_BLOCK_Q`` /
+    ``BIGDL_FLASH_BLOCK_K`` override them process-wide so hardware block
+    sweeps (``tools/experiments/exp_flash_blocks.py``) need no code
+    change.
     """
+    import os
+
+    if block_q is None:
+        block_q = int(os.environ.get("BIGDL_FLASH_BLOCK_Q", "128"))
+    if block_k is None:
+        block_k = int(os.environ.get("BIGDL_FLASH_BLOCK_K", "128"))
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if interpret is None:

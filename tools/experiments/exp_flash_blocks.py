@@ -1,0 +1,25 @@
+"""Flash-attention block-size sweep on the transformer_lm bench config.
+
+Block sizes trade VMEM residency against grid parallelism; the right
+point is hardware-specific, so sweep on the chip:
+
+    python tools/experiments/exp_flash_blocks.py
+
+Uses the BIGDL_FLASH_BLOCK_Q/K env override (ops/attention.py) so every
+run times the bench-identical step.
+"""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+for bq, bk in [(128, 128), (256, 128), (128, 256), (256, 256),
+               (512, 128), (64, 128)]:
+    env = dict(os.environ, BIGDL_FLASH_BLOCK_Q=str(bq),
+               BIGDL_FLASH_BLOCK_K=str(bk),
+               BENCH_CONFIGS="transformer_lm", BENCH_ITERS="16")
+    print(f"### block_q={bq} block_k={bk}", flush=True)
+    subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                   env=env, cwd=REPO, check=False)
