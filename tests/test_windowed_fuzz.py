@@ -1,0 +1,137 @@
+"""Randomized-config oracle fuzz for the windowed ops — pooling
+(floor/ceil, asymmetric overflow padding, count_include_pad) and
+convolution (padding/stride/group combinations) against PyTorch over
+many sampled shapes.  These are the paths where off-by-one window
+arithmetic historically hides (the reference dedicates whole spec
+families to them, ``SpatialMaxPoolingSpec``/``SpatialConvolutionSpec``);
+the fixed-case oracles in test_layers_oracle.py pin the known cases,
+this sweep walks the config space."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+
+
+def _c(ours, theirs, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs),
+                               rtol=rtol, atol=atol)
+
+
+def _pool_cases(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        k = int(rng.randint(1, 5))
+        d = int(rng.randint(1, k + 2))
+        p = int(rng.randint(0, (k + 1) // 2 + 1))
+        p = min(p, k // 2)  # torch requires pad <= kernel/2
+        h = int(rng.randint(max(k - p, 2), 14))
+        ceil = bool(rng.randint(0, 2))
+        if ceil and p == 0 and (h - k) % d:
+            # reference divergence from torch: BigDL clips the ceil-mode
+            # last window ONLY when padding is nonzero
+            # (nn/Utils.scala:346-349), torch clips always — we follow
+            # the reference; pinned in
+            # test_ceil_no_pad_follows_reference_not_torch
+            continue
+        yield k, d, p, h, ceil
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_maxpool_fuzz_vs_torch(seed):
+    rng = np.random.RandomState(100 + seed)
+    for k, d, p, h, ceil in _pool_cases(25, seed):
+        x = rng.randn(2, 3, h, h).astype(np.float32)
+        want = F.max_pool2d(torch.tensor(x), k, d, p, ceil_mode=ceil)
+        if 0 in want.shape:
+            continue
+        layer = nn.SpatialMaxPooling(k, k, d, d, p, p)
+        if ceil:
+            layer.ceil()
+        got = layer.forward(x)
+        _c(got, want.numpy()), (k, d, p, h, ceil)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_avgpool_fuzz_vs_torch(seed):
+    rng = np.random.RandomState(200 + seed)
+    for k, d, p, h, include in _pool_cases(25, seed):
+        x = rng.randn(2, 3, h, h).astype(np.float32)
+        want = F.avg_pool2d(torch.tensor(x), k, d, p,
+                            ceil_mode=False, count_include_pad=include)
+        if 0 in want.shape:
+            continue
+        got = nn.SpatialAveragePooling(
+            k, k, d, d, p, p, count_include_pad=include).forward(x)
+        _c(got, want.numpy()), (k, d, p, h, include)
+
+
+def test_ceil_no_pad_follows_reference_not_torch():
+    """k=1, d=2, h=2, p=0, ceil: the reference's output-size rule
+    (``nn/Utils.scala:338-349``) yields ceil((h-k)/d)+1 = 2 because its
+    last-window clip is gated on nonzero padding; torch clips always and
+    yields 1.  We implement the REFERENCE semantics."""
+    x = np.arange(2 * 1 * 2 * 2, dtype=np.float32).reshape(2, 1, 2, 2)
+    got = np.asarray(nn.SpatialMaxPooling(1, 1, 2, 2).ceil().forward(x))
+    assert got.shape == (2, 1, 2, 2)  # reference formula, not torch's 1x1
+    ref = F.max_pool2d(torch.tensor(x), 1, 2, 0, ceil_mode=True)
+    assert tuple(ref.shape) == (2, 1, 1, 1)
+    # where the grids overlap the values agree
+    _c(got[:, :, :1, :1], ref.numpy())
+
+
+def test_conv_fuzz_vs_torch():
+    rng = np.random.RandomState(7)
+    for _ in range(20):
+        k = int(rng.randint(1, 5))
+        s = int(rng.randint(1, 3))
+        p = int(rng.randint(0, 3))
+        g = int(rng.choice([1, 1, 2]))
+        cin = int(rng.randint(1, 4)) * g
+        cout = int(rng.randint(1, 4)) * g
+        h = int(rng.randint(k + 1, 12))
+        x = rng.randn(2, cin, h, h).astype(np.float32)
+        m = nn.SpatialConvolution(cin, cout, k, k, s, s, p, p, n_group=g)
+        w = np.asarray(m.weight)
+        b = np.asarray(m.bias)
+        want = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=s, padding=p, groups=g)
+        got = m.evaluate().forward(x)
+        _c(got, want.numpy(), rtol=2e-4, atol=2e-4), (k, s, p, g, cin, cout, h)
+
+
+def test_conv_backward_fuzz_vs_torch():
+    """Gradients too: input + weight grads across sampled configs (the
+    autodiff path through conv_general_dilated's transpose)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.module import functional_call, state_dict
+
+    rng = np.random.RandomState(8)
+    for _ in range(8):
+        k = int(rng.randint(1, 4))
+        s = int(rng.randint(1, 3))
+        p = int(rng.randint(0, 2))
+        cin, cout = int(rng.randint(1, 4)), int(rng.randint(1, 4))
+        h = int(rng.randint(k + 1, 10))
+        x = rng.randn(2, cin, h, h).astype(np.float32)
+        m = nn.SpatialConvolution(cin, cout, k, k, s, s, p, p)
+        params = state_dict(m, kind="param")
+
+        def loss(p_, x_):
+            out, _ = functional_call(m, p_, x_)
+            return jnp.sum(out ** 2)
+
+        gp, gx = jax.grad(loss, argnums=(0, 1))(params, jnp.asarray(x))
+
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(np.asarray(m.weight), requires_grad=True)
+        tb = torch.tensor(np.asarray(m.bias), requires_grad=True)
+        tout = F.conv2d(tx, tw, tb, stride=s, padding=p)
+        tout.pow(2).sum().backward()
+        _c(gx, tx.grad.numpy(), rtol=2e-3, atol=2e-3)
+        _c(gp["weight"], tw.grad.numpy(), rtol=2e-3, atol=2e-3)
+        _c(gp["bias"], tb.grad.numpy(), rtol=2e-3, atol=2e-3)
