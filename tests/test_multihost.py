@@ -38,19 +38,19 @@ def _worker_env(**extra) -> dict:
     return env
 
 
-def _run_cluster(tmp_path, tag: str, **extra) -> str:
-    """Run the worker on a 2-process cluster; return the coordinator's
-    saved-params path."""
+def _run_cluster(tmp_path, tag: str, nproc: int = 2, **extra) -> str:
+    """Run the worker on an ``nproc``-process cluster; return the
+    coordinator's saved-params path."""
     port = _free_port()
     out = str(tmp_path / f"{tag}.npz")
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER],
             env=_worker_env(BIGDL_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                            BIGDL_NUM_PROCESSES=2, BIGDL_PROCESS_ID=pid,
+                            BIGDL_NUM_PROCESSES=nproc, BIGDL_PROCESS_ID=pid,
                             BIGDL_TEST_OUT=out, **extra),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for pid in range(2)
+        for pid in range(nproc)
     ]
     outputs = []
     try:
@@ -89,6 +89,16 @@ def _assert_same_params(path_a: str, path_b: str):
 def test_two_process_training_matches_single_process(tmp_path):
     mp = _run_cluster(tmp_path, "mp")
     sp = _run_single(tmp_path, "sp")
+    _assert_same_params(mp, sp)
+
+
+def test_four_process_training_matches_single_process(tmp_path):
+    """Scale the control-plane test to 4 processes (4 x 2 virtual devices
+    = an 8-device global mesh): the trajectory must still match the
+    single process — the multi-host path's behavior is process-count
+    invariant, the property pod-scale training rests on."""
+    mp = _run_cluster(tmp_path, "mp4", nproc=4)
+    sp = _run_single(tmp_path, "sp4")
     _assert_same_params(mp, sp)
 
 
