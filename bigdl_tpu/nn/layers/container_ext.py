@@ -13,6 +13,7 @@ from bigdl_tpu.nn.module import Container, Module
 
 __all__ = [
     "Concat", "ConcatTable", "ParallelTable", "MapTable", "TimeDistributed",
+    "Remat",
 ]
 
 
@@ -58,6 +59,35 @@ class MapTable(Container):
     def update_output(self, input):
         m = self.layers[0]
         return [m.forward(x) for x in input]
+
+
+class Remat(Container):
+    """Gradient checkpointing / rematerialization boundary: activations
+    inside the wrapped module are NOT saved for the backward pass —
+    ``jax.checkpoint`` recomputes them during the gradient, trading
+    recompute FLOPs for HBM (the standard TPU memory lever; no reference
+    analogue — BigDL materializes every layer's output by design).
+
+    Wrap repeated blocks of a deep model::
+
+        nn.Sequential(*[nn.Remat(block()) for _ in range(depth)])
+
+    Exact: forward values and gradients are bit-identical to the
+    unwrapped module (dropout keys derive from the same fold_in chain on
+    recompute), only the memory/compute schedule changes.
+    """
+
+    def __init__(self, module: Module, policy=None):
+        super().__init__()
+        self.add(module)
+        self._policy = policy
+
+    def update_output(self, input):
+        import jax
+
+        inner = self.layers[0]
+        fn = jax.checkpoint(lambda v: inner.forward(v), policy=self._policy)
+        return fn(input)
 
 
 class TimeDistributed(Container):

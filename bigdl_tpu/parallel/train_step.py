@@ -84,7 +84,8 @@ class TrainStep:
                  batch_axes=(DATA_AXIS,),
                  extra_sharding_rules: Optional[Callable] = None,
                  gradient_clipping: Optional[Tuple[float, float]] = None,
-                 max_norm: Optional[float] = None):
+                 max_norm: Optional[float] = None,
+                 remat: bool = False):
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -96,6 +97,7 @@ class TrainStep:
         self.extra_sharding_rules = extra_sharding_rules
         self.gradient_clipping = gradient_clipping
         self.max_norm = max_norm
+        self.remat = remat
 
         self.params = state_dict(model, kind="param")
         self.buffers = state_dict(model, kind="buffer")
@@ -164,6 +166,12 @@ class TrainStep:
                     reg_loss = reg_loss + reg.loss(params[path])
             new_buffers = {k: new_state[k] for k in buffers}
             return loss + reg_loss, (loss, new_buffers, out)
+
+        if self.remat:
+            # whole-model rematerialization: the backward recomputes the
+            # forward instead of saving every activation — HBM for FLOPs
+            # (finer-grained boundaries: wrap blocks in nn.Remat instead)
+            loss_fn = jax.checkpoint(loss_fn, static_argnums=())
 
         def step(params, opt_state, buffers, x, y, key):
             if mesh is not None:
