@@ -98,6 +98,15 @@ def _configs():
             lambda b: tokens(b, 512, 32000, 32000, seq_targets=True),
             nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True),
             32),
+        # long-context single-chip: flash attention (O(S) memory) +
+        # per-block rematerialization at seq 4096
+        "transformer_lm_long": (
+            lambda: models.build_transformer_lm(
+                32000, num_layers=6, embed_dim=512, num_heads=8,
+                max_len=4096, remat=True),
+            lambda b: tokens(b, 4096, 32000, 32000, seq_targets=True),
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True),
+            4),
     }
 
 

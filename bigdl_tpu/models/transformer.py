@@ -49,10 +49,12 @@ def build_transformer_lm(vocab_size: int, num_layers: int = 4,
                          dropout: float = 0.0, backend="auto",
                          sp_mesh=None, sp_axis: str = "seq",
                          sp_strategy: str = "ring",
-                         sp_batch_axis=None) -> nn.Module:
+                         sp_batch_axis=None,
+                         remat: bool = False) -> nn.Module:
     """Causal decoder-only LM over [batch, seq] token ids.
     ``sp_batch_axis`` composes sequence parallelism with data
-    parallelism on a 2-D (data, seq) mesh."""
+    parallelism on a 2-D (data, seq) mesh; ``remat`` wraps each block in
+    ``nn.Remat`` so long-context activations are recomputed, not stored."""
     if sp_mesh is not None:
         from bigdl_tpu.parallel.sequence import (
             make_sequence_parallel_attention)
@@ -65,9 +67,10 @@ def build_transformer_lm(vocab_size: int, num_layers: int = 4,
         PositionalEmbedding(max_len, embed_dim),
     )
     for _ in range(num_layers):
-        model.add(nn.TransformerBlock(embed_dim, num_heads,
-                                      mlp_ratio=mlp_ratio, dropout=dropout,
-                                      causal=True, backend=backend))
+        block = nn.TransformerBlock(embed_dim, num_heads,
+                                    mlp_ratio=mlp_ratio, dropout=dropout,
+                                    causal=True, backend=backend)
+        model.add(nn.Remat(block) if remat else block)
     model.add(nn.LayerNorm(embed_dim))
     model.add(nn.TimeDistributed(nn.Sequential(
         nn.Linear(embed_dim, vocab_size), nn.LogSoftMax())))
