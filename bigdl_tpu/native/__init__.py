@@ -128,6 +128,12 @@ def _try_load() -> Optional[ctypes.CDLL]:
         lib.bigdl_batch_crop_normalize.argtypes = [
             u8p] + [ctypes.c_int] * 6 + [i32p, i32p, u8p, f32p, f32p, f32p,
                                          ctypes.c_int]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.bigdl_parse_examples.restype = ctypes.c_int64
+        lib.bigdl_parse_examples.argtypes = [
+            u8p, i64p, ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p),
+            i32p, i64p, ctypes.POINTER(u8p), ctypes.c_int32,
+            ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -319,3 +325,83 @@ def batch_crop_normalize(imgs: np.ndarray, crop_h: int, crop_w: int,
             patch = patch[:, ::-1, :]
         out[i] = ((patch.astype(np.float32) - mean) / std).transpose(2, 0, 1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Batch tf.Example parsing (native proto-wire walker)
+# ---------------------------------------------------------------------------
+def parse_examples_fixed(records, spec, num_threads: int = 0):
+    """Parse serialized tf.Example records into dense arrays.
+
+    ``spec``: list of ``(key, kind, count)`` where kind is ``"bytes"``
+    (fixed-length raw payload -> uint8 [n, count]), ``"int64"``
+    (-> int64 [n, count]) or ``"float"`` (-> float32 [n, count]).
+    Returns one array per spec entry.  C++ multi-threaded when the
+    native library is loaded; falls back to the Python wire walker
+    (``dataset/tfrecord.parse_example``) otherwise.  Raises ValueError
+    on a malformed record or a key/kind/size mismatch.
+    """
+    import ctypes
+
+    kind_code = {"bytes": 0, "int64": 1, "float": 2}
+    n = len(records)
+    outs = []
+    for key, kind, count in spec:
+        if kind == "bytes":
+            outs.append(np.empty((n, count), np.uint8))
+        elif kind == "int64":
+            outs.append(np.empty((n, count), np.int64))
+        elif kind == "float":
+            outs.append(np.empty((n, count), np.float32))
+        else:
+            raise ValueError(f"unknown kind {kind!r}")
+    if n == 0:
+        return outs
+
+    lib = _try_load()
+    if lib is not None:
+        blob = b"".join(records)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(r) for r in records], out=offsets[1:])
+        blob_arr = np.frombuffer(blob, np.uint8)
+        keys = (ctypes.c_char_p * len(spec))(
+            *[k.encode() for k, _, _ in spec])
+        kinds = np.asarray([kind_code[k] for _, k, _ in spec], np.int32)
+        counts = np.asarray([c for _, _, c in spec], np.int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        out_ptrs = (u8p * len(spec))(
+            *[o.ctypes.data_as(u8p) for o in outs])
+        rc = lib.bigdl_parse_examples(
+            _u8(blob_arr), offsets.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)), n, keys, _i32(kinds),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out_ptrs, len(spec), num_threads)
+        if rc != 0:
+            raise ValueError(
+                f"record {-int(rc) - 1} failed to parse (missing key, "
+                f"wrong kind, or size mismatch)")
+        return outs
+
+    # pure-Python fallback: the reference walker, one record at a time
+    from bigdl_tpu.dataset.tfrecord import parse_example
+
+    for i, rec in enumerate(records):
+        feats = parse_example(bytes(rec))
+        for (key, kind, count), out in zip(spec, outs):
+            if key not in feats:
+                raise ValueError(f"record {i} failed to parse (missing "
+                                 f"key {key!r})")
+            v = feats[key]
+            if kind == "bytes":
+                if not isinstance(v, list) or len(v) != 1 \
+                        or len(v[0]) != count:
+                    raise ValueError(f"record {i} failed to parse "
+                                     f"(bytes size mismatch for {key!r})")
+                out[i] = np.frombuffer(v[0], np.uint8)
+            else:
+                arr = np.asarray(v).reshape(-1)
+                if isinstance(v, list) or arr.size != count:
+                    raise ValueError(f"record {i} failed to parse "
+                                     f"(size/kind mismatch for {key!r})")
+                out[i] = arr
+    return outs

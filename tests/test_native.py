@@ -107,3 +107,86 @@ def test_batch_crop_normalize():
     patch = imgs[1, 1:9, 0:8, :][:, ::-1, :].astype(np.float32)
     want = ((patch - mean) / std).transpose(2, 0, 1)
     np.testing.assert_allclose(out[1], want, rtol=1e-6)
+
+
+# -- batch tf.Example parsing (round 4: native ingest hot path) ------------
+
+def _mk_example(img_bytes, label_vals, float_vals, packed=False):
+    from bigdl_tpu.utils.protowire import emit_bytes, emit_float, emit_varint
+
+    import struct
+
+    def feature_bytes(b):
+        return emit_bytes(1, emit_bytes(1, b))
+
+    def feature_ints(vals):
+        if packed:
+            payload = b"".join(
+                _varint_raw(v) for v in vals)
+            return emit_bytes(3, emit_bytes(1, payload))
+        return emit_bytes(3, b"".join(emit_varint(1, v) for v in vals))
+
+    def feature_floats(vals):
+        if packed:
+            payload = b"".join(struct.pack("<f", v) for v in vals)
+            return emit_bytes(2, emit_bytes(1, payload))
+        return emit_bytes(2, b"".join(emit_float(1, v) for v in vals))
+
+    feats = b""
+    for k, v in (("image", feature_bytes(img_bytes)),
+                 ("label", feature_ints(label_vals)),
+                 ("x", feature_floats(float_vals))):
+        feats += emit_bytes(1, emit_bytes(1, k.encode()) + emit_bytes(2, v))
+    return emit_bytes(1, feats)
+
+
+def _varint_raw(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_parse_examples_fixed_native_and_fallback(packed, monkeypatch):
+    """The C++ batch parser and the Python walker agree on packed and
+    unpacked encodings (tf writes packed; our emit helpers write
+    unpacked)."""
+    rng = np.random.default_rng(3)
+    recs, want_img, want_lab, want_x = [], [], [], []
+    for _ in range(32):
+        img = rng.integers(0, 255, 27, dtype=np.uint8)
+        lab = [int(rng.integers(0, 9)), int(rng.integers(100, 10 ** 7))]
+        x = [float(v) for v in rng.standard_normal(4)]
+        recs.append(_mk_example(img.tobytes(), lab, x, packed=packed))
+        want_img.append(img)
+        want_lab.append(lab)
+        want_x.append(x)
+    spec = [("image", "bytes", 27), ("label", "int64", 2), ("x", "float", 4)]
+
+    img, lab, x = native.parse_examples_fixed(recs, spec)
+    np.testing.assert_array_equal(img, np.stack(want_img))
+    np.testing.assert_array_equal(lab, np.asarray(want_lab))
+    np.testing.assert_allclose(x, np.asarray(want_x, np.float32), rtol=1e-6)
+
+    monkeypatch.setenv("BIGDL_TPU_NO_NATIVE", "1")
+    img2, lab2, x2 = native.parse_examples_fixed(recs, spec)
+    np.testing.assert_array_equal(img, img2)
+    np.testing.assert_array_equal(lab, lab2)
+    np.testing.assert_allclose(x, x2)
+
+
+def test_parse_examples_fixed_error_reporting():
+    good = _mk_example(b"abc", [1], [0.5])
+    bad = _mk_example(b"abcd", [1], [0.5])  # wrong bytes length
+    spec = [("image", "bytes", 3), ("label", "int64", 1), ("x", "float", 1)]
+    native.parse_examples_fixed([good], spec)
+    with pytest.raises(ValueError, match="record 1"):
+        native.parse_examples_fixed([good, bad], spec)
+    with pytest.raises(ValueError, match="record 0"):
+        native.parse_examples_fixed(
+            [good], [("missing", "bytes", 3)] + spec[1:])

@@ -82,17 +82,36 @@ def make_dataset(files, h, w, crop, batch, mean, std):
     from bigdl_tpu.dataset.dataset import DataSet
     from bigdl_tpu.dataset.image import LabeledImage, MTImageToBatch
     from bigdl_tpu.dataset.minibatch import MiniBatch
-    from bigdl_tpu.dataset.tfrecord import TFRecordIterator, parse_example
+    from bigdl_tpu.dataset.tfrecord import TFRecordIterator
     from bigdl_tpu.dataset.transformer import Transformer
 
     class DecodeExamples(Transformer):
+        """Chunked batch decode through the native (C++ multithreaded)
+        Example parser; Python wire walker as fallback.  Chunks of one
+        minibatch keep the prefetcher's stream smooth instead of
+        stalling a whole file's decode at file boundaries."""
+
         def apply(self, it):
-            for path in it:
-                for rec in TFRecordIterator(path):
-                    ex = parse_example(rec)
-                    img = np.frombuffer(ex["image"][0], np.uint8) \
-                        .reshape(h, w, 3)
-                    yield LabeledImage(img, int(ex["label"][0]))
+            from bigdl_tpu import native
+
+            def chunks():
+                buf = []
+                for path in it:
+                    for rec in TFRecordIterator(path):
+                        buf.append(rec)
+                        if len(buf) == batch:
+                            yield buf
+                            buf = []
+                if buf:
+                    yield buf
+
+            for recs in chunks():
+                imgs, labels = native.parse_examples_fixed(
+                    recs, [("image", "bytes", h * w * 3),
+                           ("label", "int64", 1)])
+                for i in range(len(recs)):
+                    yield LabeledImage(imgs[i].reshape(h, w, 3),
+                                       int(labels[i, 0]))
 
     class ToMiniBatch(Transformer):
         def apply(self, it):
