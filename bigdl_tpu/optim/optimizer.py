@@ -186,6 +186,8 @@ class Optimizer:
         self._ckpt_path = None
         self._ckpt_trigger = None
         self._ckpt_overwrite = False
+        self._ckpt_backend = "btpu"
+        self._pending_sharded_restore = None
         # summaries
         self._train_summary = None
         self._val_summary = None
@@ -216,9 +218,19 @@ class Optimizer:
         self._val_methods = list(methods)
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       backend: str = "btpu") -> "Optimizer":
+        """``backend="btpu"`` (default): gather to the coordinator and
+        write whole-model BTPU files — the reference's driver-side
+        saveModel (``Optimizer.scala:284-322``).  ``backend="sharded"``:
+        every host writes only its own array shards via orbax
+        (``utils/sharded_ckpt.py``) — the pod-scale layout where the
+        model may not fit one host."""
+        if backend not in ("btpu", "sharded"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
         self._ckpt_path = path
         self._ckpt_trigger = trigger
+        self._ckpt_backend = backend
         return self
 
     def overwrite_checkpoint(self) -> "Optimizer":
@@ -293,6 +305,16 @@ class Optimizer:
     def _save_checkpoint(self, step: TrainStep):
         if self._checkpoint_dir() is None:
             return
+        if self._ckpt_backend == "sharded":
+            # per-host shard writes — no gather, no single writer
+            from bigdl_tpu.utils.sharded_ckpt import save_train_step
+
+            n = self.state["neval"]
+            save_train_step(step,
+                            os.path.join(self._ckpt_dir, f"sharded.{n}"),
+                            extra={"driver_state": dict(self.state)})
+            log.info(f"[Checkpoint] saved sharded.{n} to {self._ckpt_dir}")
+            return
         from bigdl_tpu.utils.module_format import dumps
 
         # every process participates in the gathers (collectives on a
@@ -348,6 +370,17 @@ class Optimizer:
         if d is None:
             return False
         self._join_checkpoint_write()
+        if self._ckpt_backend == "sharded":
+            from bigdl_tpu.utils.sharded_ckpt import latest_step_dir
+
+            latest = latest_step_dir(d)
+            if latest is None:
+                return False
+            # applied onto the fresh TrainStep inside _optimize_once (the
+            # restore needs the live mesh placement, which the step owns)
+            self._pending_sharded_restore = latest
+            log.info(f"[Recovery] will restore sharded state from {latest}")
+            return True
         mfile = self.get_latest_file(d, "model")
         ofile = self.get_latest_file(d, "optimMethod")
         if mfile is None or ofile is None:
@@ -449,6 +482,13 @@ class Optimizer:
             step.opt_state = jax.tree.map(
                 lambda a, b: jax.device_put(np.asarray(a), b.sharding) if mesh is not None else jax.numpy.asarray(np.asarray(a)),
                 restored, step.opt_state)
+        if self._pending_sharded_restore is not None:
+            from bigdl_tpu.utils.sharded_ckpt import restore_train_step
+
+            extra = restore_train_step(step, self._pending_sharded_restore)
+            self._pending_sharded_restore = None
+            self.state.update(extra.get("driver_state", {}))
+            step.sync_to_model()
         from bigdl_tpu.dataset.dataset import DistributedDataSet
         from bigdl_tpu.parallel.mesh import mesh_process_count
 
