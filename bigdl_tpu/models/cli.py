@@ -186,9 +186,17 @@ def cmd_train(args) -> None:
 
         method = serializer.load_optim_method(args.state_snapshot)
 
-    o = optim.LocalOptimizer(
-        model, samples, criterion, batch_size=args.batch_size,
-        end_trigger=optim.Trigger.max_epoch(args.max_epoch))
+    if getattr(args, "distributed", False):
+        # the reference's Train mains are the DISTRIBUTED entry points
+        # (spark-submit + Engine.init); here: Engine mesh over every
+        # addressable device, same loop
+        o = optim.DistriOptimizer(
+            model, samples, criterion, batch_size=args.batch_size,
+            end_trigger=optim.Trigger.max_epoch(args.max_epoch))
+    else:
+        o = optim.LocalOptimizer(
+            model, samples, criterion, batch_size=args.batch_size,
+            end_trigger=optim.Trigger.max_epoch(args.max_epoch))
     o.set_optim_method(method)
     o.set_validation(optim.Trigger.every_epoch(), val_samples, val_methods,
                      batch_size=args.batch_size)
@@ -326,6 +334,9 @@ def main(argv=None) -> None:
     t.add_argument("--state-snapshot", default=None,
                    help="resume optim method from snapshot")
     t.add_argument("--seed", type=int, default=42)
+    t.add_argument("--distributed", action="store_true",
+                   help="train on the Engine mesh over every addressable "
+                        "device (the reference's spark-submit Train mode)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="evaluate a checkpointed model")
