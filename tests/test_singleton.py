@@ -73,6 +73,53 @@ def test_conflict_warns_and_raises(fresh_lock):
         holder.wait()
 
 
+TIMED_HOLDER = textwrap.dedent("""
+    import os, sys, fcntl, time
+    fd = os.open(sys.argv[1], os.O_CREAT | os.O_RDWR, 0o600)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    print("held", flush=True)
+    time.sleep(float(sys.argv[2]))
+    os.close(fd)
+    time.sleep(30)
+""")
+
+
+def test_wait_rides_out_bounded_claim(fresh_lock):
+    """A bench-side claim with ``wait_s`` above the holder's bound must
+    acquire after the holder releases (the round-4 watcher/bench
+    collision: fail-fast lost the measurement even though the watcher's
+    probe claim was bounded)."""
+    holder = subprocess.Popen(
+        [sys.executable, "-c", TIMED_HOLDER,
+         Engine._singleton_lock_path(), "3"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        # no wait: conflict
+        assert Engine.check_singleton(force=True) is False
+        # wait past the holder's bound: acquired
+        assert Engine.check_singleton(force=True, wait_s=20) is True
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_wait_deadline_still_conflicts(fresh_lock):
+    """An UNbounded holder must still produce a conflict after the
+    deadline — the wait is a handoff grace, not an infinite block."""
+    holder = subprocess.Popen(
+        [sys.executable, "-c", HOLDER, Engine._singleton_lock_path()],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        with pytest.raises(RuntimeError, match="waited"):
+            Engine.check_singleton(raise_on_conflict=True, force=True,
+                                   wait_s=0.5)
+    finally:
+        holder.kill()
+        holder.wait()
+
+
 def test_unusable_lockfile_is_advisory(fresh_lock, monkeypatch):
     monkeypatch.setattr(Engine, "_singleton_lock_path",
                         lambda: "/nonexistent-dir/x.lock")

@@ -1,0 +1,47 @@
+#!/bin/bash
+# TPU-tunnel watcher with a deterministic device-claim handoff.
+#
+# Round-4 postmortem (VERDICT.md Weak #2): the old watcher held the
+# engine's advisory flock for up to 150s per probe, and the bench's
+# fail-fast claim lost the round's only measurement window.  This
+# version shrinks + bounds the probe claim and HARVESTS the chip on
+# first contact:
+#   * probe timeout 60s (the held-lock window) — a healthy tunnel
+#     answers in <30s, a wedged one is declared wedged at 60s;
+#   * the probe process exits immediately after the verdict, dropping
+#     both the flock and the PJRT device client;
+#   * a conflicting holder makes the probe SKIP (logged), not block;
+#   * on a successful probe the watcher runs the full `python bench.py`
+#     sweep (whose claim waits up to 210s for any bounded holder),
+#     stamps the JSON to BENCH_watch.json, touches /tmp/TPU_BACK, and
+#     exits — but a FAILED sweep (tunnel re-wedged mid-run) loops back
+#     to probing instead of consuming the round's measurement window.
+#
+# Usage: nohup bash tools/tpu_watch.sh >/dev/null 2>&1 &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG=/tmp/tpu_watch.log
+cd "$REPO"
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  # success = exit status of the probe process, NOT output matching:
+  # PJRT/absl teardown noise on stderr after the OK print must not
+  # turn a healthy probe into a miss
+  out=$(timeout 90 python -c "
+from bigdl_tpu.utils.engine import Engine
+devs = Engine.probe_backend(timeout_s=60, lock_wait_s=0)
+print('OK', devs)
+" 2>&1)
+  rc=$?
+  echo "$ts rc=$rc $(tail -1 <<<"$out")" >> "$LOG"
+  if [ "$rc" -eq 0 ]; then
+    echo "$ts TPU BACK — running bench sweep" >> "$LOG"
+    touch /tmp/TPU_BACK
+    if timeout 3600 python bench.py > "$REPO/BENCH_watch.json" 2>> "$LOG"; then
+      echo "$(date -u +%H:%M:%S) bench sweep done -> BENCH_watch.json" >> "$LOG"
+      exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) bench sweep FAILED (see BENCH_watch.json); resuming probes" >> "$LOG"
+  fi
+  sleep 600
+done
