@@ -14,7 +14,8 @@ from __future__ import annotations
 import os
 import tempfile
 
-__all__ = ["save", "load", "is_remote", "makedirs", "listdir"]
+__all__ = ["save", "load", "is_remote", "makedirs", "listdir", "exists",
+           "remove", "join"]
 
 
 def is_remote(path: str) -> bool:
@@ -112,3 +113,35 @@ def _exists(path: str) -> bool:
         except Exception:
             return False
     return os.path.exists(path)
+
+
+def exists(path: str) -> bool:
+    """Local or remote existence check."""
+    return _exists(path)
+
+
+def join(path: str, name: str) -> str:
+    """Path join that keeps remote URLs intact (``os.path.join`` on a
+    ``gs://...`` base works but hand-rolled variants proliferated; ONE
+    implementation so save/prune/discovery can't diverge)."""
+    if is_remote(path):
+        return path.rstrip("/") + "/" + name
+    return os.path.join(path, name)
+
+
+def remove(path: str):
+    """Delete a file or directory tree, local or remote — the retention
+    half of checkpoint management (the reference leaves old ``model.n``
+    files behind forever; pod-scale sharded checkpoints are too large
+    for that)."""
+    if is_remote(path):
+        fs, rel = _fs(path)
+        if fs.exists(rel):
+            fs.rm(rel, recursive=True)
+        return
+    if os.path.isdir(path):
+        import shutil
+
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.unlink(path)

@@ -10,25 +10,66 @@ re-place them under the live mesh sharding — via orbax's
 StandardCheckpointer (the TPU ecosystem's checkpoint layer; async by
 design, Tensorstore underneath).
 
+Path semantics: local paths are resolved to absolute; remote paths
+(``gs://...``) are passed to orbax VERBATIM — Tensorstore owns the
+scheme — and the small driver-state meta file rides ``utils.file``
+(fsspec) next to the shards.  The meta file doubles as the
+checkpoint-COMPLETE marker: it is written only after the state write has
+finished, only by the coordinator, and atomically (tmp+rename via
+``File.save``), so ``latest_step_dir`` can never resume from a torn
+checkpoint.
+
 Wire in through ``Optimizer.set_checkpoint(path, trigger,
 backend="sharded")`` or use directly::
 
     save_train_step(step, path, extra={"neval": 7})
     extra = restore_train_step(step, path)   # in-place, shardings kept
+
+Async composition: ``save_train_step(..., wait=False)`` returns a
+``finish()`` callable — orbax's internal async write proceeds while
+training continues; ``finish()`` blocks until the shards are durable and
+then commits the meta marker.  ``Optimizer`` uses this under
+``BIGDL_ASYNC_CHECKPOINT`` through the same ``_join_checkpoint_write``
+barrier as the BTPU backend.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
-__all__ = ["save_train_step", "restore_train_step", "latest_step_dir"]
+from bigdl_tpu.utils import file as File
+
+__all__ = ["save_train_step", "restore_train_step", "latest_step_dir",
+           "prune_old"]
 
 _META = "bigdl_meta.json"
+
+#: process-lifetime checkpointer — orbax serializes saves per instance,
+#: so one shared instance gives in-order async writes for free
+_CKPTR = None
+
+
+def _checkpointer():
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def _resolve(path: str) -> str:
+    """Absolute for local paths; VERBATIM for remote — ``os.path.abspath``
+    on ``gs://...`` would mangle it into ``$CWD/gs:/...``."""
+    return path if File.is_remote(path) else os.path.abspath(path)
+
+
+_join = File.join
 
 
 def _tree(step):
@@ -47,20 +88,45 @@ def _sanitize(tree):
     return jax.tree.map(fix, tree)
 
 
-def save_train_step(step, path: str, extra: Optional[Dict] = None):
-    """Write the TrainStep's params/opt-state/buffers sharded under
-    ``path`` (a directory), plus a small json with host-side driver
-    state.  Blocking on completion (orbax saves async internally, we
-    wait so the caller's trigger semantics match the BTPU backend)."""
-    import orbax.checkpoint as ocp
+def _is_coordinator() -> bool:
+    from bigdl_tpu.utils.engine import Engine
 
-    path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, "state"), _sanitize(_tree(step)),
-                   force=True)
-    meta = {"extra": extra or {}}
-    with open(os.path.join(path, _META), "w") as f:
-        json.dump(meta, f)
+    try:
+        return Engine.is_coordinator()
+    except Exception:  # engine not initialized (direct library use)
+        return True
+
+
+def save_train_step(step, path: str, extra: Optional[Dict] = None,
+                    wait: bool = True) -> Optional[Callable[[], None]]:
+    """Write the TrainStep's params/opt-state/buffers sharded under
+    ``path`` (a directory), then commit the meta marker (coordinator
+    only, atomic).  ``wait=True`` blocks until both are durable so the
+    caller's trigger semantics match the BTPU backend; ``wait=False``
+    returns a ``finish()`` callable that performs the blocking tail —
+    orbax's internal async write overlaps the next training steps until
+    ``finish()`` is called."""
+    path = _resolve(path)
+    ckptr = _checkpointer()
+    # a REUSED dir (overwrite_checkpoint) may carry a committed meta from
+    # a previous run: retract the complete-marker BEFORE the state is
+    # deleted/rewritten, or a crash mid-write leaves latest_step_dir
+    # advertising a torn checkpoint
+    if _is_coordinator():
+        File.remove(_join(path, _META))
+    ckptr.save(_join(path, "state"), _sanitize(_tree(step)), force=True)
+
+    def finish():
+        ckptr.wait_until_finished()
+        if _is_coordinator():
+            meta = {"extra": extra or {}}
+            File.save(json.dumps(meta).encode(), _join(path, _META),
+                      overwrite=True)
+
+    if wait:
+        finish()
+        return None
+    return finish
 
 
 def restore_train_step(step, path: str) -> Dict:
@@ -68,35 +134,55 @@ def restore_train_step(step, path: str) -> Dict:
     (each leaf restores against the step's current array as the abstract
     target, so placement follows the current mesh).  Returns the saved
     ``extra`` dict."""
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(path)
+    path = _resolve(path)
     target = _sanitize(_tree(step))
-    with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(os.path.join(path, "state"), target)
+    ckptr = _checkpointer()
+    ckptr.wait_until_finished()  # never race an in-flight save
+    restored = ckptr.restore(_join(path, "state"), target)
     step.params = restored["params"]
     step.opt_state = restored["opt_state"]
     step.buffers = restored["buffers"]
     try:
-        with open(os.path.join(path, _META)) as f:
-            return json.load(f).get("extra", {})
-    except FileNotFoundError:
+        return json.loads(File.load(_join(path, _META))).get("extra", {})
+    except OSError:
         return {}
 
 
-def latest_step_dir(root: str, prefix: str = "sharded") -> Optional[str]:
-    """Newest ``<prefix>.<n>`` checkpoint directory under ``root``."""
-    if not os.path.isdir(root):
-        return None
-    best, best_n = None, -1
-    for name in os.listdir(root):
+def _numbered(root: str, prefix: str) -> List[tuple]:
+    """``(n, path)`` for every complete ``<prefix>.<n>`` checkpoint under
+    ``root`` (meta marker present), local or remote."""
+    out = []
+    for name in File.listdir(root):
         if not name.startswith(prefix + "."):
             continue
         try:
             n = int(name.rsplit(".", 1)[1])
         except ValueError:
             continue
-        if n > best_n and os.path.exists(
-                os.path.join(root, name, _META)):
-            best_n, best = n, os.path.join(root, name)
-    return best
+        p = _join(root, name)
+        if File.exists(_join(p, _META)):
+            out.append((n, p))
+    return out
+
+
+def latest_step_dir(root: str, prefix: str = "sharded") -> Optional[str]:
+    """Newest complete ``<prefix>.<n>`` checkpoint directory under
+    ``root`` — local or remote (the resume path must see the same
+    ``gs://`` directories the save path wrote)."""
+    done = _numbered(root, prefix)
+    return max(done)[1] if done else None
+
+
+def prune_old(root: str, keep: int, prefix: str = "sharded") -> List[str]:
+    """Delete all but the newest ``keep`` complete checkpoints under
+    ``root``; returns the pruned paths.  Retention policy the reference
+    lacks (its ``model.n`` files accumulate forever) but pod-scale
+    sharded state demands."""
+    if keep < 1:
+        raise ValueError("keep must be >= 1")
+    done = sorted(_numbered(root, prefix))
+    pruned = []
+    for _, p in done[:-keep]:
+        File.remove(p)
+        pruned.append(p)
+    return pruned
