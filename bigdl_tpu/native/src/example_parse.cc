@@ -105,11 +105,14 @@ bool parse_feature(const uint8_t* data, uint64_t len, const Spec& s,
   while (c.p < c.end) {
     if (!next_field(c, &f)) return false;
     if (f.num == 1 && f.wt == 2 && s.kind == BYTES_FIXED) {
-      // BytesList { value: bytes } — the inner first bytes value
+      // BytesList { value: bytes } — exactly ONE value; extra values
+      // fail the record so native availability never changes parse
+      // semantics (the Python fallback rejects multi-value BytesLists)
       Cursor b{f.data, f.data + f.len};
       Field bf;
       if (!next_field(b, &bf) || bf.num != 1 || bf.wt != 2) return false;
       if ((int64_t)bf.len != s.count) return false;
+      if (b.p < b.end) return false;  // a second value in the list
       std::memcpy(s.out + (size_t)row * s.count, bf.data, bf.len);
       return true;
     }

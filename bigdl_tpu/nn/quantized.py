@@ -162,11 +162,41 @@ _QUANTIZABLE = {Linear: QuantizedLinear.from_float,
                 SpatialConvolution: QuantizedSpatialConvolution.from_float}
 
 
+def _converter_for(model):
+    """Exact type first, then the MRO — so well-behaved subclasses
+    (``SpatialShareConvolution``: identical math, buffer aliasing only)
+    quantize as their registered base.  A subclass that OVERRIDES the
+    forward math relative to that base (e.g. the space-to-depth masked
+    conv) must not be silently converted with base-class semantics: it
+    is skipped with a warning instead of mis-quantized or silently left
+    float (ADVICE r4: exact-type dispatch dropped such layers without a
+    trace)."""
+    import logging
+
+    t = type(model)
+    conv = _QUANTIZABLE.get(t)
+    if conv is not None:
+        return conv
+    mro = t.__mro__
+    for i, klass in enumerate(mro[1:], start=1):
+        conv = _QUANTIZABLE.get(klass)
+        if conv is None:
+            continue
+        if any("update_output" in c.__dict__ or "forward" in c.__dict__
+               for c in mro[:i]):
+            logging.getLogger("bigdl_tpu").warning(
+                f"quantize: {t.__name__} subclasses {klass.__name__} but "
+                f"overrides its forward math — left in float")
+            return None
+        return conv
+    return None
+
+
 def quantize(model: Module) -> Module:
     """Swap every eligible layer for its int8 twin (in place for
     containers; returns the — possibly new — root) and switch to eval
     mode: the reference API's ``quantized_model = model.quantize()``."""
-    conv = _QUANTIZABLE.get(type(model))
+    conv = _converter_for(model)
     if conv is not None:
         return conv(model)
     if isinstance(model, Container):

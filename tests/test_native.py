@@ -173,7 +173,10 @@ def test_parse_examples_fixed_native_and_fallback(packed, monkeypatch):
     np.testing.assert_array_equal(lab, np.asarray(want_lab))
     np.testing.assert_allclose(x, np.asarray(want_x, np.float32), rtol=1e-6)
 
-    monkeypatch.setenv("BIGDL_TPU_NO_NATIVE", "1")
+    # force the Python fallback the way _try_load actually gates it (a
+    # loaded _lib early-returns before the env knob is consulted)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_build_failed", True)
     img2, lab2, x2 = native.parse_examples_fixed(recs, spec)
     np.testing.assert_array_equal(img, img2)
     np.testing.assert_array_equal(lab, lab2)
@@ -190,3 +193,28 @@ def test_parse_examples_fixed_error_reporting():
     with pytest.raises(ValueError, match="record 0"):
         native.parse_examples_fixed(
             [good], [("missing", "bytes", 3)] + spec[1:])
+
+
+def test_multivalue_byteslist_rejected_by_both_paths(monkeypatch):
+    """A BytesList with TWO values must fail the record identically in
+    the C++ parser and the Python fallback — ADVICE r4: the native path
+    silently took the first value while the fallback raised, so the same
+    record parsed or failed based on build availability."""
+    from bigdl_tpu.utils.protowire import emit_bytes, emit_varint
+
+    # image feature whose BytesList carries two 3-byte values
+    two_vals = emit_bytes(1, emit_bytes(1, b"abc") + emit_bytes(1, b"def"))
+    feats = emit_bytes(1, emit_bytes(1, b"image") + emit_bytes(2, two_vals))
+    feats += emit_bytes(1, emit_bytes(1, b"label")
+                        + emit_bytes(2, emit_bytes(3, emit_varint(1, 1))))
+    rec = emit_bytes(1, feats)
+    spec = [("image", "bytes", 3), ("label", "int64", 1)]
+
+    with pytest.raises(ValueError, match="record 0"):
+        native.parse_examples_fixed([rec], spec)
+    # force the Python fallback (a loaded _lib early-returns before the
+    # BIGDL_TPU_NO_NATIVE knob is consulted)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_build_failed", True)
+    with pytest.raises(ValueError, match="record 0"):
+        native.parse_examples_fixed([rec], spec)

@@ -103,3 +103,25 @@ def test_quantized_weight_memory_shrinks():
     fbytes = np.asarray(m.weight).nbytes
     qbytes = np.asarray(q.weight_q).nbytes + np.asarray(q.w_scale).nbytes
     assert qbytes < fbytes / 3.5  # ~4x smaller
+
+
+def test_quantize_subclass_dispatch(caplog):
+    """isinstance-style dispatch (ADVICE r4): a math-identical subclass
+    (SpatialShareConvolution) quantizes as its base; a subclass that
+    overrides the forward math (the space-to-depth masked conv) is left
+    float WITH a warning, never silently skipped or mis-converted."""
+    import logging
+
+    from bigdl_tpu.nn.fuse import _MaskedStride1Conv
+
+    RNG.set_seed(0)
+    share = nn.SpatialShareConvolution(3, 8, 3, 3)
+    assert isinstance(quantize(share), QuantizedSpatialConvolution)
+
+    RNG.set_seed(0)
+    masked = _MaskedStride1Conv(3, 8, 3, 3)
+    with caplog.at_level(logging.WARNING, logger="bigdl_tpu"):
+        out = quantize(masked)
+    assert out is masked  # unchanged
+    assert any("overrides its forward math" in r.message
+               for r in caplog.records)
