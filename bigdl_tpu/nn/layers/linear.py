@@ -99,6 +99,26 @@ class Bilinear(Module):
         return y
 
 
+def _left_align(w, input):
+    """Reference CMul/CAdd expand semantics (``CMul.scala:68-77``): a
+    lower-rank weight gets ONE leading batch dim prepended then expands
+    dim-by-dim; a higher-rank weight (caffe Scale reloads as (1,n,1,1))
+    sheds trailing singletons.  numpy's silent right-alignment — which
+    would scale the WRONG axis with the same output shape — is never
+    allowed: rank mismatches that the reference rejects raise here."""
+    if w.ndim > input.ndim:
+        while w.ndim > input.ndim and w.shape[-1] == 1:
+            w = w.reshape(w.shape[:-1])
+    elif w.ndim < input.ndim:
+        w = w.reshape((1,) + w.shape)  # CMul.scala:71
+    if w.ndim != input.ndim:
+        raise ValueError(
+            f"CMul/CAdd parameter of shape {tuple(w.shape)} cannot "
+            f"expand to a rank-{input.ndim} input (reference expand "
+            f"prepends exactly one batch dim)")
+    return w
+
+
 class CMul(Module):
     """Learnable per-element scale, broadcast over the batch
     (``nn/CMul.scala``)."""
@@ -115,7 +135,7 @@ class CMul(Module):
         self.weight = RandomUniform(-std, std).init(self.size)
 
     def update_output(self, input):
-        return input * self.weight
+        return input * _left_align(self.weight, input)
 
 
 class CAdd(Module):
@@ -133,7 +153,7 @@ class CAdd(Module):
         self.bias = RandomUniform(-std, std).init(self.size)
 
     def update_output(self, input):
-        return input + self.bias
+        return input + _left_align(self.bias, input)
 
 
 class Mul(Module):

@@ -427,7 +427,8 @@ class CaffeLoader:
         if t == "BatchNorm":
             w = self.blobs.get(name)
             n = w[0].size if w else in_channels
-            m = nn.SpatialBatchNormalization(n, affine=False)
+            eps = float(lay.get("batch_norm_param", {}).get("eps", 1e-5))
+            m = nn.SpatialBatchNormalization(n, eps, affine=False)
             if w:
                 scale = 1.0 / w[2].reshape(-1)[0] if len(w) > 2 and \
                     w[2].reshape(-1)[0] != 0 else 1.0

@@ -208,11 +208,16 @@ class CaffePersister:
                 p["bias_term"] = False
             return self._add(name, "InnerProduct", bottoms, name,
                              {"inner_product_param": p}, blobs)
-        if isinstance(m, nn.SpatialBatchNormalization):
+        if isinstance(m, nn.BatchNormalization):
+            # ONE branch for both variants (SpatialBatchNormalization is
+            # a subclass with identical math): caffe's BatchNorm
+            # normalizes axis 1 of ANY blob shape, so the same
+            # BatchNorm(+Scale) pair serves (N,C) and (N,C,H,W)
             name = self._name_of(m, "bn")
             top = self._add(
                 name, "BatchNorm", bottoms, name,
-                {"batch_norm_param": {"use_global_stats": True}},
+                {"batch_norm_param": {"use_global_stats": True,
+                                      "eps": float(m.eps)}},
                 [np.asarray(m.running_mean), np.asarray(m.running_var),
                  np.ones((1,), np.float32)])
             if m.affine:
@@ -251,6 +256,13 @@ class CaffePersister:
             if type(m) is cls:
                 name = self._name_of(m, caffe_type.lower())
                 return self._add(name, caffe_type, bottoms, name)
+        if isinstance(m, nn.LogSoftMax):
+            # caffe has no LogSoftmax layer: emit Softmax -> Log (both
+            # in the loader's converter set), mathematically identical
+            name = self._name_of(m, "softmax")
+            top = self._add(name, "Softmax", bottoms, name)
+            lname = self._fresh("log")
+            return self._add(lname, "Log", [top], lname)
         if isinstance(m, nn.SpatialCrossMapLRN):
             name = self._name_of(m, "lrn")
             return self._add(name, "LRN", bottoms, name, {"lrn_param": {

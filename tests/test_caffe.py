@@ -285,6 +285,26 @@ def test_persister_sequential_cnn_roundtrip():
     assert 'name: "conv1"' in text and 'name: "fc"' in text
 
 
+def test_persister_batchnorm_eps_and_1d_roundtrip():
+    """Non-default eps must survive the round-trip (it is part of the
+    normalization math, 1.2e-3 divergence when dropped), for BOTH the
+    spatial and the dense (N,C) BatchNormalization variants — realistic
+    running stats, not fresh-init."""
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+
+    rs = np.random.RandomState(11)
+    bn = nn.BatchNormalization(6, eps=1e-3)
+    bn.weight = jnp.asarray(rs.rand(6) + 0.5, jnp.float32)
+    bn.bias = jnp.asarray(rs.randn(6), jnp.float32)
+    bn.running_mean = jnp.asarray(rs.randn(6), jnp.float32)
+    bn.running_var = jnp.asarray(rs.rand(6) * 1e-2, jnp.float32)  # eps matters
+    model = nn.Sequential(nn.Linear(3, 6), bn, nn.ReLU())
+    x = rs.randn(4, 3).astype(np.float32)
+    _roundtrip(model, (1, 3), x)
+
+
 def test_persister_batchnorm_scale_roundtrip():
     import jax.numpy as jnp
 
@@ -351,3 +371,27 @@ def test_prototxt_writer_parses_back():
     assert layers[1]["bottom"] == ["p", "d"]
     assert layers[1]["eltwise_param"]["coeff"] == [1.0, -1.0]
     assert layers[0]["pooling_param"]["pool"] == "MAX"
+
+
+@pytest.mark.parametrize("name,build,shape", [
+    ("lenet", lambda: _zoo().build_lenet5(10), (1, 28, 28)),
+    ("vgg16_cifar", lambda: _zoo().build_vgg_for_cifar10(10), (3, 32, 32)),
+    ("inception_v1", lambda: _zoo().build_inception_v1(100), (3, 224, 224)),
+])
+def test_persister_zoo_roundtrip(name, build, shape):
+    """VERDICT r4 next-step #8: the models that matter round-trip
+    through prototxt+caffemodel with numeric equivalence (reference
+    contract ``CaffePersister.scala:47``).  Exercises the LogSoftMax ->
+    Softmax+Log emission, the 1-D BatchNormalization emitter, and the
+    left-aligned Scale reload."""
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(0)
+    model = build()
+    x = np.random.default_rng(0).normal(size=(2,) + shape).astype(np.float32)
+    _roundtrip(model, (1,) + shape, x)
+
+
+def _zoo():
+    from bigdl_tpu import models
+    return models
