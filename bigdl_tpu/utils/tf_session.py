@@ -23,7 +23,10 @@ Beyond the reference's reader set (its ``handleReaderNode`` matches ONLY
 ``TFRecordReaderV2``, ``Session.scala:128-131``): ``TextLineReaderV2``
 (+V1, incl. ``skip_header_lines``) feeding ``DecodeCSV`` — the classic
 TF 1.x CSV pipeline (filename queue -> TextLineReader -> decode_csv ->
-batch queue), record defaults and field delimiter honored.
+batch queue), record defaults and field delimiter honored — and
+``FixedLengthRecordReaderV2`` (+V1, incl. header/footer bytes) whose
+raw records flow through ``DecodeRaw``/``StridedSlice``/``Reshape``/
+``Cast`` chains: the classic CIFAR-10 binary pipeline.
 
 Supported topologies (round 4): several enqueues into one queue (streams
 union, ``handleDistriDequeue``); several dequeues over one queue (the
@@ -64,10 +67,11 @@ def _split_ref(ref: str) -> Tuple[str, int]:
 
 
 class _Source(tuple):
-    """Record source behind a pipeline endpoint: ``("tfrecord", files)``
-    or ``("textline", files, skip_header_lines, delim, defaults)`` —
-    a plain tuple so the existing source-equality checks ("components
-    read different files") keep working."""
+    """Record source behind a pipeline endpoint: ``("tfrecord", files)``,
+    ``("textline", files, skip_header_lines, delim, defaults)``, or
+    ``("fixedlen", files, header_bytes, "", (record_bytes,
+    footer_bytes))`` — a plain tuple so the existing source-equality
+    checks ("components read different files") keep working."""
 
     def __new__(cls, kind, files, skip=0, delim=",", defaults=()):
         return super().__new__(cls, (kind, tuple(files), skip, delim,
@@ -75,7 +79,7 @@ class _Source(tuple):
 
     kind = property(lambda s: s[0])
     files = property(lambda s: list(s[1]))
-    skip = property(lambda s: s[2])
+    skip = property(lambda s: s[2])  # textline: header LINES; fixedlen: BYTES
     delim = property(lambda s: s[3])
     defaults = property(lambda s: s[4])
 
@@ -250,6 +254,25 @@ class TFTrainingSession:
         return _Source("textline", self._filenames(reader["inputs"][1]),
                        skip, delim, tuple(defaults))
 
+    def _fixedlen_source(self, reader: Dict) -> _Source:
+        """``ReaderReadV2`` over a FixedLengthRecordReader -> the files
+        plus (record_bytes, footer_bytes); header bytes ride ``skip``."""
+        reader_impl = self._follow_identity(reader["inputs"][0])
+        if reader_impl["op"] not in ("FixedLengthRecordReaderV2",
+                                     "FixedLengthRecordReader"):
+            raise NotImplementedError(
+                f"reader {reader_impl['op']} unsupported for a raw-record "
+                f"source (want FixedLengthRecordReader)")
+        a = reader_impl["attrs"]
+        record_bytes = int(a.get("record_bytes") or 0)
+        if record_bytes <= 0:
+            raise ValueError("FixedLengthRecordReader needs record_bytes")
+        if int(a.get("hop_bytes") or 0):
+            raise NotImplementedError("hop_bytes (overlapping records)")
+        return _Source("fixedlen", self._filenames(reader["inputs"][1]),
+                       int(a.get("header_bytes") or 0), "",
+                       (record_bytes, int(a.get("footer_bytes") or 0)))
+
     def _enqueue_spec(self, enq: Dict):
         """One enqueue op -> (filenames, comps)."""
         filenames: Optional[List[str]] = None
@@ -257,7 +280,7 @@ class TFTrainingSession:
         for ref in enq["inputs"][1:]:
             if ref.startswith("^"):  # control dep, not a data component
                 continue
-            src, port, chain = self._component_chain(ref)
+            src, port, chain, fp = self._component_chain(ref)
             if src["op"] == "DecodeCSV":
                 files = self._csv_source(src)
                 if not 0 <= port < len(files.defaults):
@@ -266,6 +289,22 @@ class TFTrainingSession:
                 # key = the CSV field index; dtype from its default Const
                 comps.append((port, np.dtype(files.defaults[port][0]).type,
                               [], chain))
+            elif src["op"] in _READER_OPS:
+                # fixed-length raw record: port 1 is the value output;
+                # the chain (DecodeRaw -> slices/reshape/cast) owns the
+                # typing, so the KEY carries the chain fingerprint —
+                # (port, uint8, []) alone is indistinct, which would
+                # make the multi-enqueue same-spec guard vacuous
+                if port != 1:
+                    raise NotImplementedError(
+                        f"reader output port {port} enqueued (only the "
+                        f"value, port 1, is supported)")
+                if not chain:
+                    raise NotImplementedError(
+                        "raw reader value reaches the queue undecoded "
+                        "(no DecodeRaw in its chain)")
+                files = self._fixedlen_source(src)
+                comps.append(((port, fp), np.uint8, [], chain))
             else:
                 keys, dtypes, shapes, first_dense = self._dense_spec(src)
                 di = port - first_dense
@@ -336,14 +375,19 @@ class TFTrainingSession:
     _HOST_OPS = {"DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp",
                  "DecodeRaw", "Cast", "Reshape", "ExpandDims", "Squeeze",
                  "Sub", "Add", "AddV2", "Mul", "RealDiv", "Div",
-                 "ResizeBilinear"}
+                 "ResizeBilinear", "StridedSlice", "Slice", "Transpose"}
 
     def _component_chain(self, ref: str):
         """Walk one enqueue component back to its ParseExample output,
         collecting the host-op chain as compiled per-record CLOSURES in
         APPLICATION order (consts resolved ONCE, not per record).
-        Returns (parse_node, parse_port, [fn(value) -> value, ...])."""
+        Returns (parse_node, parse_port, [fn(value) -> value, ...],
+        fingerprint) — the fingerprint is a hashable summary of the
+        chain's SEMANTICS (ops + const operands + attrs, not node
+        names), so two enqueues union only when their decode chains
+        compute the same thing."""
         chain = []
+        fp = []
         cur = ref
         while True:
             # step Identity hops one at a time so the ":port" suffix of
@@ -356,9 +400,13 @@ class TFTrainingSession:
                 cur = [i for i in src["inputs"]
                        if not i.startswith("^")][0]
                 continue
-            if src["op"] in _PARSE_OPS or src["op"] == "DecodeCSV":
+            if src["op"] in _PARSE_OPS or src["op"] == "DecodeCSV" \
+                    or src["op"] in _READER_OPS:
+                # terminals: parse op (tfrecord), DecodeCSV (textline),
+                # or the ReaderRead itself (fixed-length raw records)
                 chain.reverse()
-                return src, port, chain
+                fp.reverse()
+                return src, port, chain, tuple(fp)
             if src["op"] not in self._HOST_OPS:
                 raise NotImplementedError(
                     f"enqueued component from {src['op']} unsupported "
@@ -370,10 +418,34 @@ class TFTrainingSession:
                     self._follow_identity(data_ins[0])["op"] == "Const":
                 data_idx = 1
             chain.append(self._compile_host_op(src, data_idx))
+            fp.append(self._node_fingerprint(src, data_ins, data_idx))
             cur = data_ins[data_idx]
+
+    def _node_fingerprint(self, src: Dict, data_ins, data_idx: int):
+        """Semantic identity of one chain node: op + const operand
+        contents + attrs — stable across graph-unique node names."""
+        parts = [src["op"]]
+        for i, ref in enumerate(data_ins):
+            if i == data_idx:
+                continue
+            try:
+                c = self._const_of(ref)
+                parts.append((c.dtype.str, tuple(c.shape), c.tobytes()))
+            except (NotImplementedError, KeyError):
+                parts.append(("nonconst", _split_ref(ref)[1]))
+        parts.append(tuple(sorted(
+            (k, repr(v)) for k, v in src["attrs"].items())))
+        return tuple(parts)
 
     def _const_of(self, ref: str) -> np.ndarray:
         node = self._follow_identity(ref)
+        if node["op"] == "Fill":
+            # constant-folded Fill(dims, value) — TF emits these for
+            # e.g. default stride vectors
+            ins = [i for i in node["inputs"] if not i.startswith("^")]
+            dims = self._const_of(ins[0]).reshape(-1)
+            val = self._const_of(ins[1]).reshape(-1)[0]
+            return np.full(tuple(int(d) for d in dims), val)
         if node["op"] != "Const":
             raise NotImplementedError(
                 f"expected Const operand, got {node['op']}")
@@ -409,6 +481,37 @@ class TFTrainingSession:
         if op == "Squeeze":
             dims = tuple(int(d) for d in (a.get("squeeze_dims") or []))
             return lambda value: np.squeeze(np.asarray(value), dims or None)
+        if op == "Transpose":
+            perm = tuple(int(p) for p in self._const_of(ins[1]).reshape(-1))
+            return lambda value: np.transpose(np.asarray(value), perm)
+        if op == "Slice":
+            begin = self._const_of(ins[1]).reshape(-1)
+            size = self._const_of(ins[2]).reshape(-1)
+            sl = tuple(slice(int(b), None if s == -1 else int(b + s))
+                       for b, s in zip(begin, size))
+            return lambda value: np.asarray(value)[sl]
+        if op == "StridedSlice":
+            begin = self._const_of(ins[1]).reshape(-1)
+            end = self._const_of(ins[2]).reshape(-1)
+            strides = self._const_of(ins[3]).reshape(-1)
+            bm = int(a.get("begin_mask") or 0)
+            em = int(a.get("end_mask") or 0)
+            sam = int(a.get("shrink_axis_mask") or 0)
+            if int(a.get("ellipsis_mask") or 0) \
+                    or int(a.get("new_axis_mask") or 0):
+                raise NotImplementedError(
+                    "StridedSlice ellipsis/new-axis masks")
+            idx = []
+            for i in range(len(begin)):
+                if sam & (1 << i):  # integer index: selects + drops dim
+                    idx.append(int(begin[i]))
+                else:
+                    idx.append(slice(
+                        None if bm & (1 << i) else int(begin[i]),
+                        None if em & (1 << i) else int(end[i]),
+                        int(strides[i])))
+            idx = tuple(idx)
+            return lambda value: np.asarray(value)[idx]
         if op == "ResizeBilinear":
             from bigdl_tpu.nn.layers.shape import ResizeBilinear
 
@@ -472,6 +575,8 @@ class TFTrainingSession:
 
         if isinstance(source, _Source) and source.kind == "textline":
             return self._textline_rows(source, comps)
+        if isinstance(source, _Source) and source.kind == "fixedlen":
+            return self._fixedlen_rows(source, comps)
         filenames = source.files if isinstance(source, _Source) else source
         out = []
         for path in filenames:
@@ -536,6 +641,40 @@ class TFTrainingSession:
                         v = np.dtype(dts).type(dval)
                     else:
                         v = dtype(raw)
+                    for fn in chain:
+                        v = fn(v)
+                    row.append(np.asarray(v))
+                rows.append(tuple(row))
+        return rows
+
+    def _fixedlen_rows(self, source: _Source, comps
+                       ) -> List[Tuple[np.ndarray, ...]]:
+        """Fixed-length binary records (CIFAR-10 binary layout): skip
+        ``header_bytes`` (rides ``skip``), step ``record_bytes`` chunks,
+        stop ``footer_bytes`` short of the end; every component's chain
+        (DecodeRaw -> slices -> reshape -> cast ...) runs per record."""
+        record_bytes, footer = source.defaults
+        rows = []
+        for path in source.files:
+            with open(path, "rb") as f:
+                data = f.read()
+            end = len(data) - footer
+            off = source.skip
+            if (end - off) % record_bytes:
+                # TF's FixedLengthRecordReader silently drops a partial
+                # tail (it returns OutOfRange there); warn, don't raise
+                import logging
+
+                logging.getLogger("bigdl_tpu").warning(
+                    f"{path!r}: dropping {(end - off) % record_bytes} "
+                    f"trailing bytes (not a whole "
+                    f"record_bytes={record_bytes} record)")
+            while off + record_bytes <= end:
+                rec = data[off:off + record_bytes]
+                off += record_bytes
+                row = []
+                for _key, _dtype, _shape, chain in comps:
+                    v = rec
                     for fn in chain:
                         v = fn(v)
                     row.append(np.asarray(v))
