@@ -464,9 +464,16 @@ class TFTrainingSession:
             return lambda value: np.asarray(dec.update_output(value))
         if op == "DecodeRaw":
             dt = a.get("out_type")
-            dt = _TF_DTYPES.get(dt[1] if isinstance(dt, tuple) else dt,
-                                np.uint8)
-            return lambda value: np.frombuffer(bytes(value), dt).copy()
+            dt = np.dtype(_TF_DTYPES.get(
+                dt[1] if isinstance(dt, tuple) else dt, np.uint8))
+            # little_endian defaults True in TF; big-endian formats
+            # (IDX/network-order records) would otherwise decode
+            # byte-swapped with no error
+            le = a.get("little_endian")
+            if le is not None and not le and dt.itemsize > 1:
+                dt = dt.newbyteorder(">")
+            return lambda value: np.frombuffer(bytes(value), dt) \
+                .astype(dt.newbyteorder("="), copy=True)
         if op == "Cast":
             dt = a.get("DstT")
             dt = _TF_DTYPES.get(dt[1] if isinstance(dt, tuple) else dt,

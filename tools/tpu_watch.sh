@@ -37,8 +37,33 @@ print('OK', devs)
   if [ "$rc" -eq 0 ]; then
     echo "$ts TPU BACK — running bench sweep" >> "$LOG"
     touch /tmp/TPU_BACK
-    if timeout 3600 python bench.py > "$REPO/BENCH_watch.json" 2>> "$LOG"; then
+    if timeout -k 30 3600 python bench.py > "$REPO/BENCH_watch.json" 2>> "$LOG"; then
       echo "$(date -u +%H:%M:%S) bench sweep done -> BENCH_watch.json" >> "$LOG"
+      # harvest the REST of the runbook (docs/tpu_runbook.md) while the
+      # chip answers: profiles, real-data ingest, A/B experiments, TTA.
+      # Each leg bounded + logged; failures don't stop later legs.
+      OUT="$REPO/bench_watch"
+      mkdir -p "$OUT"
+      leg() {
+        name=$1; secs=$2; shift 2
+        echo "$(date -u +%H:%M:%S) leg $name start" >> "$LOG"
+        # -k: a leg wedged in an uninterruptible device call ignores
+        # TERM; KILL escalation keeps the harvest moving
+        timeout -k 30 "$secs" "$@" > "$OUT/$name.log" 2>&1
+        rc=$?  # BEFORE the $(date) below — command substitution resets $?
+        echo "$(date -u +%H:%M:%S) leg $name rc=$rc" >> "$LOG"
+      }
+      leg inception_profile 1200 python tools/profile_bench.py inception_v1_imagenet
+      leg resnet_profile    1200 python tools/profile_bench.py resnet50_imagenet
+      leg batch_sweep       1800 python tools/batch_sweep.py
+      leg realdata          1200 python tools/realdata_bench.py --config inception --iters 16
+      leg exp_fused         1200 python tools/experiments/exp_fused.py
+      leg exp_pool          1200 python tools/experiments/exp_pool_separable.py
+      leg exp_layout        1200 python tools/experiments/exp_layout.py
+      leg exp_flash         1200 python tools/experiments/exp_flash_blocks.py
+      leg exp_remat         1800 python tools/experiments/exp_remat.py
+      leg tta_lenet         1200 python tools/tta_bench.py --model lenet --target 0.95
+      echo "$(date -u +%H:%M:%S) runbook harvest complete -> bench_watch/" >> "$LOG"
       exit 0
     fi
     echo "$(date -u +%H:%M:%S) bench sweep FAILED (see BENCH_watch.json); resuming probes" >> "$LOG"
