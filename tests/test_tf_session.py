@@ -519,3 +519,4 @@ def test_fixedlen_pipeline_trains(fixedlen_pipeline_graphdef):
     logprob = np.asarray(trained.evaluate().forward(x))
     acc = (logprob.argmax(1) == y).mean()
     assert acc > 0.95, f"trained accuracy {acc} too low"
+

@@ -1,0 +1,94 @@
+"""Guard-surface tests for the TF-session reader families that need
+NO TensorFlow (hand-built node dicts + pure-numpy paths) — kept outside
+test_tf_session.py, whose module-level importorskip would silently skip
+them on TF-less environments."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.tf_session import TFTrainingSession
+
+
+def _node(name, op, inputs=(), **attrs):
+    return {"name": name, "op": op, "inputs": list(inputs), "attrs": attrs}
+
+
+def test_reader_guards_without_tf():
+    """The honest-error guard surface of the new reader families, driven
+    on hand-built node dicts (no TF needed): string CSV fields,
+    hop_bytes, wrong reader for a source kind, and incompatible
+    multi-enqueue sources."""
+    # string CSV record_default -> NotImplementedError
+    nodes = [
+        _node("files", "Const", value=np.asarray([b"f.csv"])),
+        _node("fq", "FIFOQueueV2"),
+        _node("enq_f", "QueueEnqueueV2", ["fq", "files"]),
+        _node("rdr", "TextLineReaderV2"),
+        _node("read", "ReaderReadV2", ["rdr", "fq"]),
+        _node("d0", "Const", value=np.asarray([b"x"])),  # string default
+        _node("csv", "DecodeCSV", ["read:1", "d0"]),
+    ]
+    sess = TFTrainingSession(nodes)
+    with pytest.raises(NotImplementedError, match="string CSV"):
+        sess._csv_source(sess.by_name["csv"])
+
+    # hop_bytes on a fixed-length reader -> NotImplementedError
+    nodes2 = [
+        _node("files", "Const", value=np.asarray([b"f.bin"])),
+        _node("fq", "FIFOQueueV2"),
+        _node("enq_f", "QueueEnqueueV2", ["fq", "files"]),
+        _node("rdr", "FixedLengthRecordReaderV2",
+              record_bytes=10, hop_bytes=5),
+        _node("read", "ReaderReadV2", ["rdr", "fq"]),
+    ]
+    sess2 = TFTrainingSession(nodes2)
+    with pytest.raises(NotImplementedError, match="hop_bytes"):
+        sess2._fixedlen_source(sess2.by_name["read"])
+
+    # a TFRecord reader is not a valid CSV source (and vice versa)
+    nodes3 = [n.copy() for n in nodes2]
+    nodes3[3] = _node("rdr", "TFRecordReaderV2")
+    sess3 = TFTrainingSession(nodes3)
+    with pytest.raises(NotImplementedError, match="want FixedLengthRecordReader"):
+        sess3._fixedlen_source(sess3.by_name["read"])
+    with pytest.raises(NotImplementedError, match="want TextLineReader"):
+        sess3._csv_source(_node("csv", "DecodeCSV", ["read:1"]))
+
+
+def test_incompatible_multi_enqueue_sources_raise():
+    """Two enqueues into one queue whose sources differ in KIND (or CSV
+    config) must refuse to union (the _union_sources guard)."""
+    from bigdl_tpu.utils.tf_session import _Source, _union_sources
+
+    a = _Source("tfrecord", ["a.tfrecord"])
+    b = _Source("textline", ["b.csv"], 0, ",", (("<f4", 0.0),))
+    with pytest.raises(NotImplementedError, match="incompatible"):
+        _union_sources(a, b)
+    # same kind, different delimiter: still incompatible
+    c = _Source("textline", ["c.csv"], 0, ";", (("<f4", 0.0),))
+    with pytest.raises(NotImplementedError, match="incompatible"):
+        _union_sources(b, c)
+    # same config: files union
+    d = _Source("textline", ["d.csv"], 0, ",", (("<f4", 0.0),))
+    u = _union_sources(b, d)
+    assert u.files == ["b.csv", "d.csv"] and u.kind == "textline"
+
+
+def test_fixedlen_partial_tail_warns_and_drops(tmp_path, caplog):
+    """TF's FixedLengthRecordReader drops a partial trailing record;
+    ours must do the same (with a warning), not raise."""
+    import logging
+
+    from bigdl_tpu.utils.tf_session import _Source
+
+    p = str(tmp_path / "t.bin")
+    with open(p, "wb") as f:
+        f.write(bytes(range(10)) + b"\x01\x02\x03")  # 2.x records of 4
+    src = _Source("fixedlen", [p], 0, "", (4, 0))
+    sess = TFTrainingSession([])
+    comps = [((1, ()), np.uint8, [],
+              [lambda v: np.frombuffer(bytes(v), np.uint8)])]
+    with caplog.at_level(logging.WARNING, logger="bigdl_tpu"):
+        rows = sess._fixedlen_rows(src, comps)
+    assert len(rows) == 3  # 13 bytes -> 3 whole records, 1 byte dropped
+    assert any("trailing bytes" in r.message for r in caplog.records)

@@ -37,7 +37,10 @@ print('OK', devs)
   if [ "$rc" -eq 0 ]; then
     echo "$ts TPU BACK — running bench sweep" >> "$LOG"
     touch /tmp/TPU_BACK
-    if timeout -k 30 3600 python bench.py > "$REPO/BENCH_watch.json" 2>> "$LOG"; then
+    # explicit short claim wait: the watcher itself holds nothing here,
+    # so a held lock means a stray second driver — fail fast with the
+    # JSON error rather than waiting into our own 3600s timeout
+    if BIGDL_SINGLETON_WAIT=210 timeout -k 30 3600 python bench.py > "$REPO/BENCH_watch.json" 2>> "$LOG"; then
       echo "$(date -u +%H:%M:%S) bench sweep done -> BENCH_watch.json" >> "$LOG"
       # harvest the REST of the runbook (docs/tpu_runbook.md) while the
       # chip answers: profiles, real-data ingest, A/B experiments, TTA.
@@ -46,6 +49,9 @@ print('OK', devs)
       mkdir -p "$OUT"
       leg() {
         name=$1; secs=$2; shift 2
+        # refresh the harvest sentinel: bench.py's long-wait mode keys
+        # on its mtime being FRESH, and the whole harvest can run ~4h40
+        touch /tmp/TPU_BACK
         echo "$(date -u +%H:%M:%S) leg $name start" >> "$LOG"
         # -k: a leg wedged in an uninterruptible device call ignores
         # TERM; KILL escalation keeps the harvest moving
