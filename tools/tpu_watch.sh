@@ -21,6 +21,14 @@
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/tpu_watch.log
+PIDFILE=/tmp/tpu_watch.pid
+# single-instance + manageable by exact pid (pgrep -f patterns match the
+# launching shell's own command line and have killed the wrong process)
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+  echo "$(date -u +%H:%M:%S) watcher already running (pid $(cat "$PIDFILE"))" >> "$LOG"
+  exit 0
+fi
+echo $$ > "$PIDFILE"
 cd "$REPO"
 while true; do
   ts=$(date -u +%H:%M:%S)
