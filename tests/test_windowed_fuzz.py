@@ -135,3 +135,120 @@ def test_conv_backward_fuzz_vs_torch():
         _c(gx, tx.grad.numpy(), rtol=2e-3, atol=2e-3)
         _c(gp["weight"], tw.grad.numpy(), rtol=2e-3, atol=2e-3)
         _c(gp["bias"], tb.grad.numpy(), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_dilated_conv_fuzz_vs_torch(seed):
+    """Atrous conv over sampled (kernel, stride, pad, dilation) configs
+    — forward AND input/weight gradients (the effective-window
+    arithmetic k_eff = (k-1)*dil + 1 is where off-by-ones hide)."""
+    rng = np.random.RandomState(300 + seed)
+    for _ in range(12):
+        k = int(rng.randint(1, 4))
+        s = int(rng.randint(1, 3))
+        dil = int(rng.randint(1, 4))
+        keff = (k - 1) * dil + 1
+        p = int(rng.randint(0, keff))
+        h = int(rng.randint(keff + 1, keff + 8))
+        cin, cout = int(rng.randint(1, 4)), int(rng.randint(1, 4))
+        x = rng.randn(2, cin, h, h).astype(np.float32)
+        layer = nn.SpatialDilatedConvolution(
+            cin, cout, k, k, s, s, p, p, dil, dil)
+        w = np.asarray(layer.weight)
+        b = np.asarray(layer.bias)
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        want = F.conv2d(tx, tw, tb, stride=s, padding=p, dilation=dil)
+        got = layer.forward(x)
+        _c(got, want.detach().numpy())
+        # gradients through the same config
+        g = rng.randn(*want.shape).astype(np.float32)
+        want.backward(torch.tensor(g))
+        layer.zero_grad_parameters()
+        gin = layer.backward(x, g)
+        _c(gin, tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+        _c(layer._grads["weight"], tw.grad.numpy(), rtol=1e-3, atol=1e-4)
+        _c(layer._grads["bias"], tb.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_full_conv_fuzz_vs_torch(seed):
+    """Transposed conv over sampled (kernel, stride, pad, adj/out-pad,
+    group) configs vs torch ConvTranspose2d — forward + gradients."""
+    rng = np.random.RandomState(400 + seed)
+    for _ in range(12):
+        k = int(rng.randint(1, 4))
+        s = int(rng.randint(1, 3))
+        p = int(rng.randint(0, k))
+        adj = int(rng.randint(0, s))  # torch: output_padding < stride
+        grp = int(rng.choice([1, 2]))
+        cin, cout = 2 * grp, 2 * grp
+        h = int(rng.randint(3, 9))
+        x = rng.randn(2, cin, h, h).astype(np.float32)
+        layer = nn.SpatialFullConvolution(
+            cin, cout, k, k, s, s, p, p, adj, adj, n_group=grp)
+        w = np.asarray(layer.weight)
+        b = np.asarray(layer.bias)
+        tx = torch.tensor(x, requires_grad=True)
+        # torch weight layout (in, out/groups, kh, kw) matches ours
+        tw = torch.tensor(w, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        want = F.conv_transpose2d(tx, tw, tb, stride=s,
+                                  padding=p, output_padding=adj,
+                                  groups=grp)
+        got = layer.forward(x)
+        _c(got, want.detach().numpy(), rtol=1e-3, atol=1e-4)
+        g = rng.randn(*want.shape).astype(np.float32)
+        want.backward(torch.tensor(g))
+        layer.zero_grad_parameters()
+        gin = layer.backward(x, g)
+        _c(gin, tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+        _c(layer._grads["weight"], tw.grad.numpy(), rtol=1e-3, atol=1e-4)
+        _c(layer._grads["bias"], tb.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_batchnorm_trainmode_fuzz_vs_torch(seed):
+    """Train-mode BN over sampled (eps, momentum, affine, rank) configs:
+    outputs AND the running-stat update rule vs torch (BigDL momentum =
+    torch momentum; unbiased-variance bookkeeping is where
+    implementations quietly differ)."""
+    rng = np.random.RandomState(500 + seed)
+    for _ in range(10):
+        c = int(rng.randint(1, 6))
+        eps = float(10.0 ** rng.uniform(-5, -2))
+        mom = float(rng.uniform(0.05, 0.5))
+        affine = bool(rng.randint(0, 2))
+        spatial = bool(rng.randint(0, 2))
+        if spatial:
+            x = rng.randn(3, c, 4, 5).astype(np.float32)
+            ours = nn.SpatialBatchNormalization(c, eps, mom, affine=affine)
+            theirs = torch.nn.BatchNorm2d(c, eps=eps, momentum=mom,
+                                          affine=affine)
+        else:
+            x = rng.randn(8, c).astype(np.float32)
+            ours = nn.BatchNormalization(c, eps, mom, affine=affine)
+            theirs = torch.nn.BatchNorm1d(c, eps=eps, momentum=mom,
+                                          affine=affine)
+        if affine:
+            w = rng.rand(c).astype(np.float32) + 0.5
+            b_ = rng.randn(c).astype(np.float32)
+            ours.weight, ours.bias = w, b_
+            with torch.no_grad():
+                theirs.weight.copy_(torch.tensor(w))
+                theirs.bias.copy_(torch.tensor(b_))
+        theirs.train()
+        for it in range(2):  # two steps: the update rule must COMPOSE
+            want = theirs(torch.tensor(x))
+            got = ours.forward(x)
+            _c(got, want.detach().numpy(), rtol=1e-3, atol=1e-4)
+        _c(ours.running_mean, theirs.running_mean.numpy(),
+           rtol=1e-4, atol=1e-5)
+        _c(ours.running_var, theirs.running_var.numpy(),
+           rtol=1e-4, atol=1e-5)
+        # eval mode uses the accumulated stats
+        theirs.eval()
+        ours.evaluate()
+        _c(ours.forward(x), theirs(torch.tensor(x)).detach().numpy(),
+           rtol=1e-3, atol=1e-4)
