@@ -156,7 +156,8 @@ class Optimizer:
         return object.__new__(cls)
 
     def __init__(self, model, dataset, criterion, batch_size: Optional[int] = None,
-                 end_trigger: Optional[Trigger] = None):
+                 end_trigger: Optional[Trigger] = None, *,
+                 optim_method: Optional[OptimMethod] = None):
         if isinstance(dataset, (list, tuple)):
             if batch_size is None:
                 raise ValueError("batch_size required when passing raw samples")
@@ -178,7 +179,10 @@ class Optimizer:
         self.model = model
         self.dataset: AbstractDataSet = dataset
         self.criterion = criterion
-        self.optim_method: OptimMethod = SGD()
+        # constructor kwarg for parity with the reference Python API
+        # (optimizer.py Optimizer(..., optim_method=...)); set_optim_method
+        # remains the fluent route
+        self.optim_method: OptimMethod = optim_method or SGD()
         self.end_when: Trigger = end_trigger or Trigger.max_iteration(2**62)
         self.state: Dict = {"epoch": 1, "neval": 0}
         self.metrics = Metrics()
@@ -759,8 +763,10 @@ class LocalOptimizer(Optimizer):
     """Single-chip training (``optim/LocalOptimizer.scala``)."""
 
     def __init__(self, model, dataset, criterion, batch_size: Optional[int] = None,
-                 end_trigger: Optional[Trigger] = None):
-        super().__init__(model, dataset, criterion, batch_size, end_trigger)
+                 end_trigger: Optional[Trigger] = None, *,
+                 optim_method: Optional[OptimMethod] = None):
+        super().__init__(model, dataset, criterion, batch_size, end_trigger,
+                         optim_method=optim_method)
         self._mesh = None
 
 
@@ -770,6 +776,10 @@ class DistriOptimizer(Optimizer):
     sharded) update inside the compiled step."""
 
     def __init__(self, model, dataset, criterion, batch_size: Optional[int] = None,
-                 end_trigger: Optional[Trigger] = None, mesh=None):
-        super().__init__(model, dataset, criterion, batch_size, end_trigger)
+                 end_trigger: Optional[Trigger] = None, *, mesh=None,
+                 optim_method: Optional[OptimMethod] = None):
+        # mesh/optim_method keyword-only: positional slot 6 would differ
+        # between the two interchangeable Optimizer classes
+        super().__init__(model, dataset, criterion, batch_size, end_trigger,
+                         optim_method=optim_method)
         self._mesh = mesh if mesh is not None else Engine.mesh

@@ -493,3 +493,16 @@ def test_remat_with_dropout_deterministic():
     n1 = sorted(round(float(jnp.linalg.norm(v)), 5) for v in g1.values())
     n2 = sorted(round(float(jnp.linalg.norm(v)), 5) for v in g2.values())
     assert n1 == n2
+
+
+def test_constructor_optim_method_kwarg():
+    """Reference python-API parity: Optimizer(..., optim_method=...) in
+    the constructor, equivalent to set_optim_method."""
+    samples, _, _ = _make_data()
+    o = optim.LocalOptimizer(_mlp(), samples, nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(2),
+                             optim_method=optim.Adam(learning_rate=0.01))
+    assert isinstance(o.optim_method, optim.Adam)
+    o.optimize()
+    assert o.state["neval"] >= 2

@@ -144,22 +144,26 @@ def test_dilated_conv_fuzz_vs_torch(seed):
     arithmetic k_eff = (k-1)*dil + 1 is where off-by-ones hide)."""
     rng = np.random.RandomState(300 + seed)
     for _ in range(12):
-        k = int(rng.randint(1, 4))
-        s = int(rng.randint(1, 3))
-        dil = int(rng.randint(1, 4))
-        keff = (k - 1) * dil + 1
-        p = int(rng.randint(0, keff))
-        h = int(rng.randint(keff + 1, keff + 8))
+        # RECTANGULAR everywhere: kw!=kh, per-axis stride/pad/dilation
+        # and h!=w inputs are what catch transposed-axis arithmetic
+        kw, kh = int(rng.randint(1, 4)), int(rng.randint(1, 4))
+        sw, sh = int(rng.randint(1, 3)), int(rng.randint(1, 3))
+        dw_, dh_ = int(rng.randint(1, 4)), int(rng.randint(1, 4))
+        kweff, kheff = (kw - 1) * dw_ + 1, (kh - 1) * dh_ + 1
+        pw, ph = int(rng.randint(0, kweff)), int(rng.randint(0, kheff))
+        w_in = int(rng.randint(kweff + 1, kweff + 8))
+        h_in = int(rng.randint(kheff + 1, kheff + 8))
         cin, cout = int(rng.randint(1, 4)), int(rng.randint(1, 4))
-        x = rng.randn(2, cin, h, h).astype(np.float32)
+        x = rng.randn(2, cin, h_in, w_in).astype(np.float32)
         layer = nn.SpatialDilatedConvolution(
-            cin, cout, k, k, s, s, p, p, dil, dil)
+            cin, cout, kw, kh, sw, sh, pw, ph, dw_, dh_)
         w = np.asarray(layer.weight)
         b = np.asarray(layer.bias)
         tx = torch.tensor(x, requires_grad=True)
         tw = torch.tensor(w, requires_grad=True)
         tb = torch.tensor(b, requires_grad=True)
-        want = F.conv2d(tx, tw, tb, stride=s, padding=p, dilation=dil)
+        want = F.conv2d(tx, tw, tb, stride=(sh, sw), padding=(ph, pw),
+                        dilation=(dh_, dw_))
         got = layer.forward(x)
         _c(got, want.detach().numpy())
         # gradients through the same config
@@ -178,24 +182,26 @@ def test_full_conv_fuzz_vs_torch(seed):
     group) configs vs torch ConvTranspose2d — forward + gradients."""
     rng = np.random.RandomState(400 + seed)
     for _ in range(12):
-        k = int(rng.randint(1, 4))
-        s = int(rng.randint(1, 3))
-        p = int(rng.randint(0, k))
-        adj = int(rng.randint(0, s))  # torch: output_padding < stride
+        kw, kh = int(rng.randint(1, 4)), int(rng.randint(1, 4))
+        sw, sh = int(rng.randint(1, 3)), int(rng.randint(1, 3))
+        pw, ph = int(rng.randint(0, kw)), int(rng.randint(0, kh))
+        adjw = int(rng.randint(0, sw))  # torch: output_padding < stride
+        adjh = int(rng.randint(0, sh))
         grp = int(rng.choice([1, 2]))
         cin, cout = 2 * grp, 2 * grp
-        h = int(rng.randint(3, 9))
-        x = rng.randn(2, cin, h, h).astype(np.float32)
+        h_in, w_in = int(rng.randint(3, 9)), int(rng.randint(3, 9))
+        x = rng.randn(2, cin, h_in, w_in).astype(np.float32)
         layer = nn.SpatialFullConvolution(
-            cin, cout, k, k, s, s, p, p, adj, adj, n_group=grp)
+            cin, cout, kw, kh, sw, sh, pw, ph, adjw, adjh, n_group=grp)
         w = np.asarray(layer.weight)
         b = np.asarray(layer.bias)
         tx = torch.tensor(x, requires_grad=True)
         # torch weight layout (in, out/groups, kh, kw) matches ours
         tw = torch.tensor(w, requires_grad=True)
         tb = torch.tensor(b, requires_grad=True)
-        want = F.conv_transpose2d(tx, tw, tb, stride=s,
-                                  padding=p, output_padding=adj,
+        want = F.conv_transpose2d(tx, tw, tb, stride=(sh, sw),
+                                  padding=(ph, pw),
+                                  output_padding=(adjh, adjw),
                                   groups=grp)
         got = layer.forward(x)
         _c(got, want.detach().numpy(), rtol=1e-3, atol=1e-4)
