@@ -628,6 +628,10 @@ def _attr_ints(key: str, ints: Sequence[int]) -> bytes:
     return _attr(key, pw.emit_bytes(1, lst))
 
 
+def _attr_i(key: str, v: int) -> bytes:
+    return _attr(key, pw.emit_varint(3, v))
+
+
 def _tensor_proto(arr: np.ndarray) -> bytes:
     arr = np.asarray(arr)
     dt = {np.dtype(np.float32): _DT_FLOAT, np.dtype(np.int32): _DT_INT32,
@@ -649,10 +653,15 @@ def _node_def(name: str, op: str, inputs: Sequence[str],
 
 def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
     """Serialize a module tree to a binary GraphDef; returns output node
-    names.  Supported: Sequential chains of Linear, SpatialConvolution
-    (NCHW), ReLU/ReLU6/Tanh/Sigmoid, SoftMax/LogSoftMax, pooling,
-    Reshape/InferReshape/View, Dropout (exported as Identity), Identity.
-    (``BigDLToTensorflow.scala`` analogue.)"""
+    names.  Supported (the reference ``BigDLToTensorflow.scala`` set):
+    Sequential chains AND branching structures — Concat /
+    ConcatTable+CAddTable/CMulTable/JoinTable (Inception- and
+    ResNet-style DAGs) — of Linear, SpatialConvolution (NCHW; explicit
+    pads become a Pad node), max/avg pooling, BatchNormalization (both
+    variants, exported as the frozen running-stats affine like the
+    reference's BatchNorm2DToTF), ReLU/ReLU6/Tanh/Sigmoid,
+    SoftMax/LogSoftMax, Reshape/InferReshape/View, Squeeze, Mean,
+    SpatialZeroPadding, Dropout (exported as Identity), Identity."""
     import bigdl_tpu.nn as nn
 
     out = [_node_def(input_name, "Placeholder", [],
@@ -670,12 +679,45 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
                                  8, _tensor_proto(np.asarray(arr,
                                                              np.float32))))))
 
+    def iconst(name, arr):
+        out.append(_node_def(name, "Const", [],
+                             _attr_type("dtype", _DT_INT32)
+                             + _attr("value", pw.emit_bytes(
+                                 8, _tensor_proto(np.asarray(arr,
+                                                             np.int32))))))
+
+    def concat_v2(name, parts, axis):
+        iconst(name + "/axis", axis)
+        out.append(_node_def(name, "ConcatV2",
+                             list(parts) + [name + "/axis"],
+                             _attr_type("T", _DT_FLOAT)
+                             + _attr_type("Tidx", _DT_INT32)
+                             + _attr_i("N", len(parts))))
+        return name
+
+    def pad_node(name, cur, ph, pw_):
+        iconst(name + "/pads",
+               [[0, 0], [0, 0], [ph, ph], [pw_, pw_]])
+        out.append(_node_def(name, "Pad", [cur, name + "/pads"],
+                             _attr_type("T", _DT_FLOAT)
+                             + _attr_type("Tpaddings", _DT_INT32)))
+        return name
+
     def emit(module, cur: str) -> str:
         if isinstance(module, nn.Sequential):
             for m in module.__dict__["_modules"].values():
                 cur = emit(m, cur)
             return cur
         name = fresh(type(module).__name__)
+        if isinstance(cur, list) and not isinstance(
+                module, (nn.CAddTable, nn.CMulTable, nn.JoinTable)):
+            raise NotImplementedError(
+                f"table output (ConcatTable upstream) consumed by "
+                f"non-table layer {type(module).__name__}")
+        if getattr(module, "format", "NCHW") != "NCHW":
+            raise NotImplementedError(
+                f"{type(module).__name__} export supports NCHW only "
+                f"(module format {module.format!r})")
         if isinstance(module, nn.Linear):
             wname, bname = name + "/w", name + "/b"
             const(wname, np.asarray(module._params["weight"]).T)
@@ -693,15 +735,23 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
                 raise NotImplementedError("grouped conv export")
             w = np.asarray(module._params["weight"])  # OIHW
             const(name + "/w", w.transpose(2, 3, 1, 0))  # HWIO
-            # NCHW input; TF Conv2D with data_format NCHW
-            if (module.pad_w, module.pad_h) not in ((0, 0), (-1, -1)):
+            # NCHW input; TF Conv2D with data_format NCHW.  TF knows
+            # only SAME/VALID, so explicit pads become a zero Pad node
+            # before a VALID conv (exact for convolution)
+            if (module.pad_w, module.pad_h) == (-1, -1):
+                padding = b"SAME"
+            elif -1 in (module.pad_w, module.pad_h):
                 raise NotImplementedError(
-                    "conv export supports pad (0, 0) or SAME (-1, -1) only")
+                    "per-axis SAME padding export (one pad -1)")
+            else:
+                padding = b"VALID"
+                if (module.pad_w, module.pad_h) != (0, 0):
+                    cur = pad_node(name + "/pad", cur,
+                                   module.pad_h, module.pad_w)
             out.append(_node_def(
                 name + "/conv", "Conv2D", [cur, name + "/w"],
                 _attr_type("T", _DT_FLOAT)
-                + _attr_s("padding", b"SAME" if module.pad_w == -1
-                          else b"VALID")
+                + _attr_s("padding", padding)
                 + _attr_s("data_format", b"NCHW")
                 + _attr_ints("strides",
                              [1, 1, module.stride_h, module.stride_w])))
@@ -713,20 +763,127 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
                                      + _attr_s("data_format", b"NCHW")))
                 cur = name
             return cur
-        if isinstance(module, nn.SpatialMaxPooling):
+        if isinstance(module, (nn.SpatialMaxPooling,
+                               nn.SpatialAveragePooling)):
+            # SpatialAveragePooling SUBCLASSES SpatialMaxPooling — test
+            # the derived class, not the base
+            is_max = not isinstance(module, nn.SpatialAveragePooling)
             if (module.pad_w, module.pad_h) not in ((0, 0), (-1, -1)) \
-                    or module.ceil_mode:
+                    or module.ceil_mode \
+                    or getattr(module, "global_pooling", False):
                 raise NotImplementedError(
                     "pooling export supports pad (0, 0) or SAME (-1, -1), "
-                    "floor mode only")
+                    "floor mode, non-global only")
+            if not is_max:
+                # TF AvgPool divides by the UNPADDED window count; SAME
+                # with count_include_pad (the module default) divides by
+                # k*k at borders — silently different numbers
+                if not module.divide:
+                    raise NotImplementedError("sum (divide=False) "
+                                              "pooling export")
+                if module.pad_w == -1 and module.count_include_pad:
+                    raise NotImplementedError(
+                        "SAME avg pooling with count_include_pad "
+                        "(TF AvgPool excludes padding from the divisor)")
             out.append(_node_def(
-                name, "MaxPool", [cur],
+                name, "MaxPool" if is_max else "AvgPool", [cur],
                 _attr_type("T", _DT_FLOAT)
                 + _attr_s("padding", b"SAME" if module.pad_w == -1
                           else b"VALID")
                 + _attr_s("data_format", b"NCHW")
                 + _attr_ints("ksize", [1, 1, module.kh, module.kw])
                 + _attr_ints("strides", [1, 1, module.dh, module.dw])))
+            return name
+        if isinstance(module, nn.BatchNormalization):
+            # frozen running-stats affine, like the reference's
+            # BatchNorm2DToTF: y = x * scale + offset with
+            # scale = w/sqrt(var+eps), offset = b - mean*scale
+            eps = float(module.eps)
+            mean = np.asarray(module.running_mean, np.float64)
+            var = np.asarray(module.running_var, np.float64)
+            scale = 1.0 / np.sqrt(var + eps)
+            offset = -mean * scale
+            if module.affine:
+                w = np.asarray(module.weight, np.float64)
+                b = np.asarray(module.bias, np.float64)
+                scale, offset = scale * w, offset * w + b
+            shape = (1, -1, 1, 1) \
+                if isinstance(module, nn.SpatialBatchNormalization) \
+                else (-1,)
+            const(name + "/scale", scale.reshape(shape))
+            const(name + "/offset", offset.reshape(shape))
+            out.append(_node_def(name + "/mul", "Mul",
+                                 [cur, name + "/scale"],
+                                 _attr_type("T", _DT_FLOAT)))
+            out.append(_node_def(name, "AddV2",
+                                 [name + "/mul", name + "/offset"],
+                                 _attr_type("T", _DT_FLOAT)))
+            return name
+        if isinstance(module, nn.Concat):
+            parts = [emit(m, cur)
+                     for m in module.__dict__["_modules"].values()]
+            return concat_v2(name, parts, int(module.dim))
+        if isinstance(module, nn.ConcatTable):
+            return [emit(m, cur)
+                    for m in module.__dict__["_modules"].values()]
+        if isinstance(module, nn.CAddTable):
+            if not isinstance(cur, list):
+                raise NotImplementedError(
+                    "CAddTable export needs a table input "
+                    "(ConcatTable upstream)")
+            out.append(_node_def(name, "AddN", cur,
+                                 _attr_type("T", _DT_FLOAT)
+                                 + _attr_i("N", len(cur))))
+            return name
+        if isinstance(module, nn.CMulTable):
+            if not isinstance(cur, list):
+                raise NotImplementedError(
+                    "CMulTable export needs a table input")
+            acc = cur[0]
+            for i, other in enumerate(cur[1:]):
+                nm = name if i == len(cur) - 2 else f"{name}/mul{i}"
+                out.append(_node_def(nm, "Mul", [acc, other],
+                                     _attr_type("T", _DT_FLOAT)))
+                acc = nm
+            return acc
+        if isinstance(module, nn.JoinTable):
+            if not isinstance(cur, list):
+                raise NotImplementedError(
+                    "JoinTable export needs a table input")
+            if module.n_input_dims:
+                raise NotImplementedError(
+                    "JoinTable export with n_input_dims (dynamic axis)")
+            return concat_v2(name, cur, int(module.dim))
+        if isinstance(module, nn.Squeeze):
+            if module.num_input_dims:
+                raise NotImplementedError(
+                    "Squeeze export with num_input_dims (dynamic axis)")
+            dims = [] if module.dim is None else [int(module.dim)]
+            out.append(_node_def(name, "Squeeze", [cur],
+                                 _attr_type("T", _DT_FLOAT)
+                                 + _attr_ints("squeeze_dims", dims)))
+            return name
+        if isinstance(module, nn.Mean):
+            if module.num_input_dims:
+                raise NotImplementedError(
+                    "Mean export with num_input_dims (dynamic axis)")
+            iconst(name + "/axis", [int(module.dim)])
+            keep = b"" if module.squeeze else _attr(
+                "keep_dims", pw.emit_varint(5, 1))  # AttrValue.b
+            out.append(_node_def(name, "Mean", [cur, name + "/axis"],
+                                 _attr_type("T", _DT_FLOAT)
+                                 + _attr_type("Tidx", _DT_INT32) + keep))
+            return name
+        if isinstance(module, nn.SpatialZeroPadding):
+            if min(module.l, module.r, module.t, module.b) < 0:
+                raise NotImplementedError(
+                    "negative (cropping) zero-padding export")
+            iconst(name + "/pads", [[0, 0], [0, 0],
+                                    [module.t, module.b],
+                                    [module.l, module.r]])
+            out.append(_node_def(name, "Pad", [cur, name + "/pads"],
+                                 _attr_type("T", _DT_FLOAT)
+                                 + _attr_type("Tpaddings", _DT_INT32)))
             return name
         simple = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
                   nn.Sigmoid: "Sigmoid", nn.SoftMax: "Softmax",
@@ -762,6 +919,12 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
             f"save_graphdef: unsupported layer {type(module).__name__}")
 
     final = emit(model, input_name)
+
+    def flat(o):
+        # a model ending in ConcatTable has several outputs
+        return [n for e in o for n in flat(e)] if isinstance(o, list) \
+            else [o]
+
     with open(path, "wb") as f:
         f.write(b"".join(out))
-    return [final]
+    return flat(final)

@@ -352,3 +352,84 @@ def test_imported_tf_graph_gets_sibling_merge():
             shapes = [tuple(v.shape)
                       for v in state_dict(opt, kind="param").values()]
             assert (1, 1, 4, 8) in shapes  # ONE trainable merged weight
+
+
+def test_export_zoo_roundtrip():
+    """The export side at the reference's BigDLToTensorflow breadth:
+    LeNet (chain), ResNet-20 with conv shortcuts (ConcatTable+CAddTable
+    DAG, BatchNorm folded to its frozen running-stats affine, explicit
+    conv pads via Pad nodes, AvgPool), and an Inception-style Concat
+    block — each saved to a GraphDef and reloaded through our own
+    TensorflowLoader with forward equality."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu import models
+    from bigdl_tpu.utils.rng import RNG
+    from bigdl_tpu.utils.tf_graph import (TensorflowLoader, parse_graphdef,
+                                          save_graphdef)
+
+    def roundtrip(model, shape, tol=1e-6):
+        x = np.random.default_rng(0).normal(
+            size=(2,) + shape).astype(np.float32)
+        path = tempfile.mktemp(".pb")
+        outs = save_graphdef(model, path)
+        nodes = parse_graphdef(open(path, "rb").read())
+        reloaded = TensorflowLoader(nodes, ["input"], outs,
+                                    train_consts=False).load()
+        a = np.asarray(model.evaluate().forward(jnp.asarray(x)))
+        b = np.asarray(reloaded.evaluate().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+    RNG.set_seed(0)
+    roundtrip(models.build_lenet5(10), (1, 28, 28))
+    RNG.set_seed(0)
+    roundtrip(models.build_resnet_cifar(20, 10, shortcut_type="B"),
+              (3, 32, 32))
+    RNG.set_seed(0)
+    # Inception-style multi-branch Concat (the padded-POOL branch of the
+    # real inception layer is excluded: zero-padding a max pool is only
+    # exact for non-negative inputs, so its export correctly raises)
+    import bigdl_tpu.nn as nn
+
+    inc = nn.Concat(1)
+    inc.add(nn.Sequential(nn.SpatialConvolution(16, 8, 1, 1),
+                          nn.ReLU(True)))
+    inc.add(nn.Sequential(nn.SpatialConvolution(16, 8, 1, 1),
+                          nn.ReLU(True),
+                          nn.SpatialConvolution(8, 12, 3, 3, 1, 1, 1, 1),
+                          nn.ReLU(True)))
+    inc.add(nn.Sequential(nn.SpatialConvolution(16, 4, 1, 1),
+                          nn.ReLU(True),
+                          nn.SpatialConvolution(4, 8, 5, 5, 1, 1, 2, 2),
+                          nn.ReLU(True)))
+    block = nn.Sequential(
+        nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(16),
+        nn.ReLU(True),
+        inc,
+        nn.SpatialAveragePooling(4, 4, 4, 4),
+        nn.View(28 * 16).set_num_input_dims(3),
+        nn.Linear(28 * 16, 10), nn.LogSoftMax())
+    roundtrip(block, (3, 16, 16), tol=1e-5)
+
+
+def test_export_guards_raise_cleanly():
+    """Unsupported-structure exports fail with diagnosable errors, not
+    silently-wrong graphs."""
+    import tempfile
+
+    import bigdl_tpu.nn as nn
+
+    for model, match in (
+            (nn.Sequential(nn.SpatialMaxPooling(2, 2, 2, 2).ceil()),
+             "floor mode"),
+            (nn.Sequential(nn.CAddTable()), "table input"),
+            (nn.Sequential(nn.SpatialZeroPadding(-1, 0, 0, 0)),
+             "negative"),
+    ):
+        from bigdl_tpu.utils.tf_graph import save_graphdef
+
+        with pytest.raises(NotImplementedError, match=match):
+            save_graphdef(model, tempfile.mktemp(".pb"))
