@@ -695,9 +695,9 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
                              + _attr_i("N", len(parts))))
         return name
 
-    def pad_node(name, cur, ph, pw_):
+    def pad_node(name, cur, hpair, wpair):
         iconst(name + "/pads",
-               [[0, 0], [0, 0], [ph, ph], [pw_, pw_]])
+               [[0, 0], [0, 0], list(hpair), list(wpair)])
         out.append(_node_def(name, "Pad", [cur, name + "/pads"],
                              _attr_type("T", _DT_FLOAT)
                              + _attr_type("Tpaddings", _DT_INT32)))
@@ -740,14 +740,15 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
             # before a VALID conv (exact for convolution)
             if (module.pad_w, module.pad_h) == (-1, -1):
                 padding = b"SAME"
-            elif -1 in (module.pad_w, module.pad_h):
+            elif module.pad_w < 0 or module.pad_h < 0:
                 raise NotImplementedError(
-                    "per-axis SAME padding export (one pad -1)")
+                    "per-axis SAME / negative conv padding export")
             else:
                 padding = b"VALID"
                 if (module.pad_w, module.pad_h) != (0, 0):
                     cur = pad_node(name + "/pad", cur,
-                                   module.pad_h, module.pad_w)
+                                   (module.pad_h, module.pad_h),
+                                   (module.pad_w, module.pad_w))
             out.append(_node_def(
                 name + "/conv", "Conv2D", [cur, name + "/w"],
                 _attr_type("T", _DT_FLOAT)
@@ -807,9 +808,13 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
                 w = np.asarray(module.weight, np.float64)
                 b = np.asarray(module.bias, np.float64)
                 scale, offset = scale * w, offset * w + b
+            # (1, -1) for the dense variant: axis-1 broadcast for 2-D
+            # inputs, and a SHAPE ERROR (not silently-wrong numbers) if
+            # a >2-D input reaches it — the module normalizes axis 1
+            # at any rank, which a static const cannot express
             shape = (1, -1, 1, 1) \
                 if isinstance(module, nn.SpatialBatchNormalization) \
-                else (-1,)
+                else (1, -1)
             const(name + "/scale", scale.reshape(shape))
             const(name + "/offset", offset.reshape(shape))
             out.append(_node_def(name + "/mul", "Mul",
@@ -858,6 +863,10 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
             if module.num_input_dims:
                 raise NotImplementedError(
                     "Squeeze export with num_input_dims (dynamic axis)")
+            if module.dim is not None and module.dim < 0:
+                raise NotImplementedError(
+                    "Squeeze export with a negative dim (the loader "
+                    "rejects negative squeeze_dims)")
             dims = [] if module.dim is None else [int(module.dim)]
             out.append(_node_def(name, "Squeeze", [cur],
                                  _attr_type("T", _DT_FLOAT)
@@ -878,13 +887,8 @@ def save_graphdef(model, path: str, input_name: str = "input") -> List[str]:
             if min(module.l, module.r, module.t, module.b) < 0:
                 raise NotImplementedError(
                     "negative (cropping) zero-padding export")
-            iconst(name + "/pads", [[0, 0], [0, 0],
-                                    [module.t, module.b],
-                                    [module.l, module.r]])
-            out.append(_node_def(name, "Pad", [cur, name + "/pads"],
-                                 _attr_type("T", _DT_FLOAT)
-                                 + _attr_type("Tpaddings", _DT_INT32)))
-            return name
+            return pad_node(name, cur, (module.t, module.b),
+                            (module.l, module.r))
         simple = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Tanh: "Tanh",
                   nn.Sigmoid: "Sigmoid", nn.SoftMax: "Softmax",
                   nn.LogSoftMax: "LogSoftmax", nn.Identity: "Identity",

@@ -108,6 +108,9 @@ class TFTrainingSession:
                                   if isinstance(graphdef, (bytes, bytearray))
                                   else list(graphdef))
         self.by_name = {n["name"]: n for n in self.nodes}
+        # one resolved file order per filename queue: several components
+        # of one parse op must see the SAME (possibly shuffled) order
+        self._filename_cache: Dict[str, List[str]] = {}
 
     # -- pipeline interpretation ------------------------------------------
     def _node(self, ref: str) -> Dict:
@@ -143,18 +146,37 @@ class TFTrainingSession:
         qnode = self._follow_identity(queue_ref)
         if qnode["op"] not in _QUEUE_OPS:
             raise ValueError(f"reader's queue is {qnode['op']}, not a queue")
+        if qnode["name"] in self._filename_cache:
+            return self._filename_cache[qnode["name"]]
         enq = self._find_enqueue(qnode["name"])
         names: List[str] = []
         for ref in enq["inputs"][1:]:
             if ref.startswith("^"):  # control dep, not a data component
                 continue
             src = self._follow_identity(ref)
+            shuffle = False
+            if src["op"] == "RandomShuffle":
+                # string_input_producer(shuffle=True) shuffles the
+                # filename tensor before the enqueue; interpret it as a
+                # host-side permutation of the file list (seeded by the
+                # global RNG, so runs are reproducible)
+                shuffle = True
+                data_ins = [i for i in src["inputs"]
+                            if not i.startswith("^")]
+                src = self._follow_identity(data_ins[0])
             if src["op"] != "Const":
                 raise NotImplementedError(
                     f"filename source {src['op']} unsupported (want Const)")
             val = src["attrs"].get("value")
-            for f in np.asarray(val).reshape(-1):
-                names.append(f.decode() if isinstance(f, bytes) else str(f))
+            batch = [f.decode() if isinstance(f, bytes) else str(f)
+                     for f in np.asarray(val).reshape(-1)]
+            if shuffle and len(batch) > 1:
+                from bigdl_tpu.utils.rng import RNG
+
+                order = np.asarray(RNG.permutation(len(batch)))
+                batch = [batch[int(i)] for i in order]
+            names.extend(batch)
+        self._filename_cache[qnode["name"]] = names
         return names
 
     def _dense_spec(self, pe: Dict) -> Tuple[List[str], List, List[List[int]], int]:
