@@ -322,8 +322,17 @@ class SGD(OptimMethod):
             grads = _tree_map(lambda g, p: g + wd * p, grads, params)
         new_state = dict(state)
         if self.momentum > 0:
-            vel = _tree_map(lambda v, g: self.momentum * v + (1.0 - self.dampening) * g,
-                            state["velocity"], grads)
+            # first step COPIES the raw gradient into the buffer —
+            # dampening applies only from step 2 (``SGD.scala:95``:
+            # ``copy(dfdx)`` on the None branch; torch matches).  With
+            # dampening 0 the formulas coincide, so this only matters
+            # for damp > 0 — which the hyperparameter fuzz caught.
+            first = state["neval"] == 0
+            vel = _tree_map(
+                lambda v, g: jnp.where(
+                    first, g,
+                    self.momentum * v + (1.0 - self.dampening) * g),
+                state["velocity"], grads)
             new_state["velocity"] = vel
             if self.nesterov:
                 step = _tree_map(lambda g, v: g + self.momentum * v, grads, vel)

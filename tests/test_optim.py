@@ -14,10 +14,12 @@ from bigdl_tpu.optim.optim_method import (
 )
 
 
-def _run_method(method, torch_cls, torch_kwargs, steps=5, shape=(7,)):
+def _run_method(method, torch_cls, torch_kwargs, steps=5, shape=(7,),
+                rng=None):
     """Run ours and torch's on the same quadratic problem; compare params."""
-    w0 = np.random.randn(*shape).astype(np.float32)
-    target = np.random.randn(*shape).astype(np.float32)
+    rng = rng or np.random
+    w0 = rng.randn(*shape).astype(np.float32)
+    target = rng.randn(*shape).astype(np.float32)
 
     params = {"w": jnp.asarray(w0)}
     state = method.init_state(params)
@@ -165,3 +167,55 @@ def test_regularizers():
                                0.1 * np.sign(np.asarray(p)))
     assert float(L1L2Regularizer(0.1, 0.2).loss(p)) == pytest.approx(
         0.1 * 6.0 + 0.5 * 0.2 * 14.0)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_optim_hyperparameter_fuzz_vs_torch(seed):
+    """Randomized-hyperparameter trajectory equivalence vs torch.optim —
+    the fixed-config oracles above pin one point each; this sweep walks
+    the (lr, momentum, nesterov, dampening, weight-decay, betas, rho...)
+    space where update-rule algebra quietly diverges between
+    implementations."""
+    rng = np.random.RandomState(600 + seed)
+
+    def u(lo, hi):
+        return float(rng.uniform(lo, hi))
+
+    cases = []
+    for _ in range(4):
+        mom = u(0.0, 0.95)
+        nesterov = bool(rng.randint(0, 2)) and mom > 0
+        damp = 0.0 if nesterov else u(0.0, 0.5)
+        wd = u(0.0, 0.05)
+        cases.append((SGD(learning_rate=u(0.005, 0.2), momentum=mom,
+                          nesterov=nesterov, dampening=damp,
+                          weight_decay=wd),
+                      torch.optim.SGD,
+                      {"lr": None, "momentum": mom, "nesterov": nesterov,
+                       "dampening": damp, "weight_decay": wd}))
+    for _ in range(3):
+        b1, b2 = u(0.8, 0.95), u(0.99, 0.9999)
+        eps = 10.0 ** u(-9, -6)
+        cases.append((Adam(learning_rate=u(0.001, 0.05), beta1=b1,
+                           beta2=b2, epsilon=eps),
+                      torch.optim.Adam,
+                      {"lr": None, "betas": (b1, b2), "eps": eps}))
+    for _ in range(2):
+        rho = u(0.85, 0.99)
+        eps = 10.0 ** u(-8, -5)
+        cases.append((Adadelta(decay_rate=rho, epsilon=eps),
+                      torch.optim.Adadelta,
+                      {"lr": 1.0, "rho": rho, "eps": eps}))
+    for _ in range(2):
+        dr = u(0.9, 0.999)
+        eps = 10.0 ** u(-9, -7)
+        cases.append((RMSprop(learning_rate=u(0.001, 0.02), decay_rate=dr,
+                              epsilon=eps),
+                      torch.optim.RMSprop,
+                      {"lr": None, "alpha": dr, "eps": eps}))
+
+    for method, tcls, kwargs in cases:
+        if kwargs.get("lr") is None:
+            kwargs["lr"] = method.learning_rate
+        _run_method(method, tcls, kwargs, steps=8,
+                    rng=np.random.RandomState(700 + seed))
