@@ -69,6 +69,8 @@ print('OK', devs)
       }
       leg inception_profile 1200 python tools/profile_bench.py inception_v1_imagenet
       leg resnet_profile    1200 python tools/profile_bench.py resnet50_imagenet
+      leg transformer_profile 1200 python tools/profile_bench.py transformer_lm
+      leg lstm_profile      1200 python tools/profile_bench.py lstm_text_large
       leg batch_sweep       1800 python tools/batch_sweep.py
       leg realdata          1200 python tools/realdata_bench.py --config inception --iters 16
       leg exp_fused         1200 python tools/experiments/exp_fused.py
