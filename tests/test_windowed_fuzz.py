@@ -258,3 +258,58 @@ def test_batchnorm_trainmode_fuzz_vs_torch(seed):
         ours.evaluate()
         _c(ours.forward(x), theirs(torch.tensor(x)).detach().numpy(),
            rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_recurrent_shape_fuzz_vs_torch(seed):
+    """LSTM/GRU through the lax.scan Recurrent over sampled
+    (batch, seq, input, hidden) shapes — fwd + input grads vs torch.
+    The fixed oracles pin one shape each; hidden==input, seq==1, and
+    wide-vs-tall shapes each stress different scan/broadcast paths."""
+    import bigdl_tpu.nn as bnn
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(700 + seed)
+    for _ in range(5):
+        b = int(rng.randint(1, 5))
+        s = int(rng.randint(1, 9))
+        inp = int(rng.randint(1, 7))
+        hid = int(rng.randint(1, 8))
+        x = rng.randn(b, s, inp).astype(np.float32)
+        gy = rng.randn(b, s, hid).astype(np.float32)
+
+        # LSTM
+        cell = bnn.LSTM(inp, hid)
+        rec = bnn.Recurrent(cell)
+        tl = torch.nn.LSTM(inp, hid, batch_first=True)
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
+            tl.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
+            tl.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.h2g.weight)))
+            tl.bias_hh_l0.zero_()
+        out = rec.forward(jnp.asarray(x))
+        tx = torch.tensor(x, requires_grad=True)
+        ref, _ = tl(tx)
+        _c(out, ref.detach().numpy(), rtol=1e-3, atol=1e-4)
+        gx = rec.backward(jnp.asarray(x), jnp.asarray(gy))
+        ref.backward(torch.tensor(gy))
+        _c(gx, tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+        # GRU
+        cell = bnn.GRU(inp, hid)
+        rec = bnn.Recurrent(cell)
+        tg = torch.nn.GRU(inp, hid, batch_first=True)
+        with torch.no_grad():
+            tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
+            tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
+            w_hh = np.concatenate([np.asarray(cell.h2rz.weight),
+                                   np.asarray(cell.h2n.weight)])
+            tg.weight_hh_l0.copy_(torch.tensor(w_hh))
+            tg.bias_hh_l0.zero_()
+        out = rec.forward(jnp.asarray(x))
+        tx = torch.tensor(x, requires_grad=True)
+        ref, _ = tg(tx)
+        _c(out, ref.detach().numpy(), rtol=1e-3, atol=1e-4)
+        gx = rec.backward(jnp.asarray(x), jnp.asarray(gy))
+        ref.backward(torch.tensor(gy))
+        _c(gx, tx.grad.numpy(), rtol=1e-3, atol=1e-4)
