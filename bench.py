@@ -45,6 +45,24 @@ PEAK_TFLOPS = {
     "TPU v7": 4614.0,
 }
 
+#: int8 MXU rate as a multiple of the bf16 peak — 2x on the chips that
+#: advertise a doubled int8 rate (v5e: 394 TOPS vs 197 TFLOP/s), 1x
+#: where int8 runs at the bf16 rate (v4); the int8 utilization
+#: denominator uses this so a genuine win is never misread
+INT8_RATIO = {
+    "TPU v4": 1.0,
+}
+
+
+def int8_peak_ratio() -> float:
+    import jax as _jax
+
+    kind = _jax.devices()[0].device_kind
+    for name, r in INT8_RATIO.items():
+        if kind.lower().startswith(name.lower()):
+            return r
+    return 2.0
+
 
 def _configs():
     """name -> (build_model, build_batch, criterion, batch)."""
@@ -211,9 +229,15 @@ INFER_CONFIGS = {"inception_v1_imagenet": 256, "vgg16_cifar10": 512}
 
 
 def run_infer_config(name, batch, iters, quantized):
-    """Inference img/s for one config, bf16 or int8-quantized — the
-    measured check on nn/quantized.py's throughput claim (VERDICT r4
-    Weak #4: 'the throughput feature is currently a comment')."""
+    """Inference img/s + op-throughput accounting for one config, bf16
+    or int8-quantized — the measured check on nn/quantized.py's
+    throughput claim (VERDICT r4 Weak #4: 'the throughput feature is
+    currently a comment').  ``utilization`` divides achieved op/s by
+    the matching peak: the chip's bf16 peak for the float leg, 2x it
+    for the int8 leg (the MXU's int8 rate on v5e: 394 TOPS vs 197
+    TFLOP/s) — so an int8 leg that merely MATCHES bf16 img/s shows
+    half the utilization, making a non-win visible."""
+    from bigdl_tpu.nn.module import state_dict
     from bigdl_tpu.nn.quantized import quantize
     from bigdl_tpu.parallel.train_step import EvalStep
     from bigdl_tpu.utils.rng import RNG
@@ -227,25 +251,48 @@ def run_infer_config(name, batch, iters, quantized):
     else:
         es = EvalStep(model, compute_dtype=jnp.bfloat16)
     x, _ = build_batch(batch)
-    jax.block_until_ready(es.run(x))  # compile + warmup
+    # ONE AOT compile serves the cost analysis AND the timed loop (the
+    # run_config aot_scan pattern) — es.run would jit the same program
+    # a second time
+    state = state_dict(model)
+    xj = jnp.asarray(x)
+    compiled = es._build().lower(state, xj).compile()
+    ops = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        ops = float(cost.get("flops") or 0) or None
+    except Exception:  # noqa: BLE001 — accounting must not sink the leg
+        pass
+    jax.block_until_ready(compiled(state, xj))  # warmup
     t0 = time.perf_counter()
     out = None
     for _ in range(iters):
-        out = es.run(x)
+        out = compiled(state, xj)
     jax.block_until_ready(out)
     wall = time.perf_counter() - t0
-    return round(batch * iters / wall, 2)
+    row = {"img_s": round(batch * iters / wall, 2)}
+    if ops:
+        achieved = ops * iters / wall
+        row["achieved_tops"] = round(achieved / 1e12, 2)
+        peak = peak_flops_per_sec()
+        if peak:
+            denom = peak * (int8_peak_ratio() if quantized else 1.0)
+            row["utilization"] = round(achieved / denom, 4)
+    return row
 
 
 def run_infer_table(iters):
-    """{config: {bf16_img_s, int8_img_s, int8_speedup}} — one table per
-    config; errors isolated per leg."""
+    """{config: {bf16_*, int8_*, int8_speedup}} — one table per config;
+    errors isolated per leg."""
     table = {}
     for name, batch in INFER_CONFIGS.items():
         row = {}
         for tag, q in (("bf16", False), ("int8", True)):
             try:
-                row[f"{tag}_img_s"] = run_infer_config(name, batch, iters, q)
+                leg = run_infer_config(name, batch, iters, q)
+                row.update({f"{tag}_{k}": v for k, v in leg.items()})
             except Exception as e:  # noqa: BLE001
                 row[f"{tag}_error"] = f"{type(e).__name__}: {e}"
         if "bf16_img_s" in row and "int8_img_s" in row:

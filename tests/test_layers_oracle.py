@@ -272,6 +272,29 @@ def test_normalize_matches_torch():
 
 # ----------------------------- rnn ----------------------------------------
 
+def sync_lstm_to_torch(cell, tl):
+    """Copy our packed (i,f,g,o) LSTM cell weights into a torch LSTM —
+    the ONE copy of the gate-packing contract shared by the fixed
+    oracles and the shape fuzz."""
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
+        tl.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
+        tl.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.h2g.weight)))
+        tl.bias_hh_l0.zero_()
+
+
+def sync_gru_to_torch(cell, tg):
+    """Copy our (r,z | n) GRU cell weights into a torch GRU."""
+    with torch.no_grad():
+        tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
+        tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
+        w_hh = np.concatenate([np.asarray(cell.h2rz.weight),
+                               np.asarray(cell.h2n.weight)])
+        tg.weight_hh_l0.copy_(torch.tensor(w_hh))
+        tg.bias_hh_l0.zero_()
+
+
+
 def test_lstm_matches_torch():
     hidden, inp = 7, 5
     cell = nn.LSTM(inp, hidden)
@@ -279,12 +302,7 @@ def test_lstm_matches_torch():
     x = np.random.randn(3, 6, inp).astype(np.float32)
 
     tl = torch.nn.LSTM(inp, hidden, batch_first=True)
-    # ours: i2g (i,f,g,o) packed; torch: (i,f,g,o) packed the same order
-    with torch.no_grad():
-        tl.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
-        tl.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
-        tl.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.h2g.weight)))
-        tl.bias_hh_l0.zero_()
+    sync_lstm_to_torch(cell, tl)
     out = rec.forward(jnp.asarray(x))
     ref, _ = tl(torch.tensor(x))
     _cmp(out, ref.detach().numpy(), rtol=1e-3, atol=1e-4)
@@ -300,11 +318,7 @@ def test_lstm_backward_matches_torch():
     gy = np.random.randn(3, 6, hidden).astype(np.float32)
 
     tl = torch.nn.LSTM(inp, hidden, batch_first=True)
-    with torch.no_grad():
-        tl.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
-        tl.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
-        tl.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.h2g.weight)))
-        tl.bias_hh_l0.zero_()
+    sync_lstm_to_torch(cell, tl)
     gx = rec.backward(jnp.asarray(x_np), jnp.asarray(gy))
     tx = torch.tensor(x_np, requires_grad=True)
     out, _ = tl(tx)
@@ -318,12 +332,7 @@ def test_gru_matches_torch():
     rec = nn.Recurrent(cell)
     x = np.random.randn(2, 5, inp).astype(np.float32)
     tg = torch.nn.GRU(inp, hidden, batch_first=True)
-    with torch.no_grad():
-        tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
-        tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
-        w_hh = np.concatenate([np.asarray(cell.h2rz.weight), np.asarray(cell.h2n.weight)])
-        tg.weight_hh_l0.copy_(torch.tensor(w_hh))
-        tg.bias_hh_l0.zero_()
+    sync_gru_to_torch(cell, tg)
     out = rec.forward(jnp.asarray(x))
     ref, _ = tg(torch.tensor(x))
     _cmp(out, ref.detach().numpy(), rtol=1e-3, atol=1e-4)
@@ -336,12 +345,7 @@ def test_gru_backward_matches_torch():
     x_np = np.random.randn(2, 5, inp).astype(np.float32)
     gy = np.random.randn(2, 5, hidden).astype(np.float32)
     tg = torch.nn.GRU(inp, hidden, batch_first=True)
-    with torch.no_grad():
-        tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
-        tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
-        w_hh = np.concatenate([np.asarray(cell.h2rz.weight), np.asarray(cell.h2n.weight)])
-        tg.weight_hh_l0.copy_(torch.tensor(w_hh))
-        tg.bias_hh_l0.zero_()
+    sync_gru_to_torch(cell, tg)
     gx = rec.backward(jnp.asarray(x_np), jnp.asarray(gy))
     tx = torch.tensor(x_np, requires_grad=True)
     out, _ = tg(tx)

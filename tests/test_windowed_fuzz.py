@@ -281,12 +281,10 @@ def test_recurrent_shape_fuzz_vs_torch(seed):
         # LSTM
         cell = bnn.LSTM(inp, hid)
         rec = bnn.Recurrent(cell)
+        from tests.test_layers_oracle import sync_lstm_to_torch
+
         tl = torch.nn.LSTM(inp, hid, batch_first=True)
-        with torch.no_grad():
-            tl.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
-            tl.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
-            tl.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.h2g.weight)))
-            tl.bias_hh_l0.zero_()
+        sync_lstm_to_torch(cell, tl)
         out = rec.forward(jnp.asarray(x))
         tx = torch.tensor(x, requires_grad=True)
         ref, _ = tl(tx)
@@ -298,14 +296,10 @@ def test_recurrent_shape_fuzz_vs_torch(seed):
         # GRU
         cell = bnn.GRU(inp, hid)
         rec = bnn.Recurrent(cell)
+        from tests.test_layers_oracle import sync_gru_to_torch
+
         tg = torch.nn.GRU(inp, hid, batch_first=True)
-        with torch.no_grad():
-            tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.weight)))
-            tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.i2g.bias)))
-            w_hh = np.concatenate([np.asarray(cell.h2rz.weight),
-                                   np.asarray(cell.h2n.weight)])
-            tg.weight_hh_l0.copy_(torch.tensor(w_hh))
-            tg.bias_hh_l0.zero_()
+        sync_gru_to_torch(cell, tg)
         out = rec.forward(jnp.asarray(x))
         tx = torch.tensor(x, requires_grad=True)
         ref, _ = tg(tx)
