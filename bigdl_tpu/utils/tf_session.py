@@ -33,7 +33,10 @@ union, ``handleDistriDequeue``); several dequeues over one queue (the
 stream splits round-robin between them, ``handleLocalDequeue``);
 dequeues over different queues (rows zip by index);
 ``RandomShuffleQueue`` (host-side seeded shuffle); and queue-less graphs
-whose compute reads ``ParseExample`` outputs directly.
+whose compute reads ``ParseExample`` outputs directly.  Round 5 adds
+shuffled filename PRODUCERS (``string_input_producer(shuffle=True)``:
+the RandomShuffle on the filename tensor becomes a reproducible
+host-side permutation, one order per queue).
 """
 
 from __future__ import annotations
