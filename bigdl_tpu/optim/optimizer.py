@@ -272,7 +272,11 @@ class Optimizer:
         return self
 
     def set_parameter_sync(self, mode: str) -> "Optimizer":
-        """'allreduce' or 'sharded' (ZeRO-1)."""
+        """'allreduce', 'sharded' (ZeRO-1: optimizer state over the data
+        axis), or 'fsdp' (ZeRO-3: parameters too — no whole replica per
+        device)."""
+        if mode not in ("allreduce", "sharded", "fsdp"):
+            raise ValueError(f"unknown parameter_sync mode {mode!r}")
         self.parameter_sync = mode
         return self
 
@@ -676,7 +680,10 @@ class Optimizer:
                         lr = self.optim_method.get_learning_rate()
                         ts.add_scalar("LearningRate", lr, self.state["neval"])
                     if gate("Parameters", self.state) and hasattr(ts, "add_histogram"):
-                        for pname, arr in step.params.items():
+                        # fsdp/TP params are cross-process-sharded on a
+                        # multi-host mesh: gather before np.asarray
+                        gathered = step.gather_replicated(step.params)
+                        for pname, arr in gathered.items():
                             ts.add_histogram(pname, np.asarray(arr),
                                              self.state["neval"])
                 if self._val_trigger is not None and self._val_trigger(self.state):

@@ -69,8 +69,12 @@ def _param_meta(model: Module):
 class TrainStep:
     """Build and run the compiled train step.
 
-    ``parameter_sync``: 'allreduce' (plain DP) or 'sharded' (ZeRO-1: shard
-    optimizer state over the data axis).
+    ``parameter_sync``: 'allreduce' (plain DP), 'sharded' (ZeRO-1: shard
+    optimizer state over the data axis), or 'fsdp' (ZeRO-3: shard the
+    PARAMETERS themselves over the data axis too — no device holds a
+    whole replica; XLA all-gathers each weight at use and lowers the
+    gradient collective to reduce-scatter.  Pure GSPMD: the sharding
+    annotations change, the step math doesn't).
     ``gradient_compression``: None or 'bf16' (reference truncation
     semantics).
     ``compute_dtype``: e.g. jnp.bfloat16 to run fwd/bwd in bf16 with f32
@@ -90,6 +94,11 @@ class TrainStep:
         self.criterion = criterion
         self.optim = optim_method
         self.mesh = mesh
+        if parameter_sync not in ("allreduce", "sharded", "fsdp"):
+            # validate where the mode is CONSUMED: a typo must not
+            # silently degrade to replicated allreduce
+            raise ValueError(f"unknown parameter_sync {parameter_sync!r} "
+                             f"(allreduce | sharded | fsdp)")
         self.parameter_sync = parameter_sync
         self.gradient_compression = gradient_compression
         self.compute_dtype = compute_dtype
@@ -117,19 +126,65 @@ class TrainStep:
             spec = self.extra_sharding_rules(path, arr)
             if spec is not None:
                 return NamedSharding(self.mesh, spec)
+        if self.parameter_sync == "fsdp" and hasattr(arr, "ndim") \
+                and arr.ndim >= 1:
+            # ZeRO-3: each weight lives sharded over the batch axis
+            # (axis 0 when divisible); XLA inserts the per-use
+            # all-gather and the reduce-scatter on its gradient.
+            # Explicit TP rules above take precedence; indivisible
+            # leaves stay replicated.
+            ax = self._zero_axis()
+            n = self.mesh.shape.get(ax, 1)
+            if n > 1 and arr.shape[0] % n == 0 and arr.shape[0] >= n:
+                return NamedSharding(
+                    self.mesh, P(*((ax,) + (None,) * (arr.ndim - 1))))
         return replicated(self.mesh)
 
+    def _zero_axis(self):
+        """The mesh axis ZeRO state shards over — the leading batch
+        axis, not a hard-coded 'data' (a mesh may name it differently)."""
+        return self.batch_axes[0] if self.batch_axes else DATA_AXIS
+
     def _opt_leaf_sharding(self, arr):
-        """ZeRO-1: shard large optimizer-state leaves over data axis."""
+        """ZeRO-1/3: shard large optimizer-state leaves over the batch
+        axis."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if self.mesh is None:
             return None
-        if self.parameter_sync == "sharded" and hasattr(arr, "ndim") and arr.ndim >= 1:
-            n = self.mesh.shape[DATA_AXIS]
-            if arr.shape[0] % n == 0 and arr.shape[0] >= n:
-                return NamedSharding(self.mesh, P(DATA_AXIS))
+        if self.parameter_sync in ("sharded", "fsdp") \
+                and hasattr(arr, "ndim") and arr.ndim >= 1:
+            ax = self._zero_axis()
+            n = self.mesh.shape.get(ax, 1)
+            if n > 1 and arr.shape[0] % n == 0 and arr.shape[0] >= n:
+                return NamedSharding(self.mesh, P(ax))
         return replicated(self.mesh)
+
+    def _opt_state_shardings(self, opt_state):
+        """Per-leaf opt-state shardings ALIGNED with the owning param's
+        layout: a TP-ruled param's moment buffers follow the TP sharding
+        (constraining them onto the ZeRO axis would force a per-step
+        resharding collective); everything else gets the ZeRO layout."""
+        rules = self.extra_sharding_rules
+
+        def leaf(path, arr):
+            if rules is not None and hasattr(arr, "ndim"):
+                # the innermost dict key is the param name for the
+                # per-param moment trees (velocity/m/v/...)
+                key = None
+                for part in reversed(path):
+                    if hasattr(part, "key"):
+                        key = part.key
+                        break
+                if key is not None:
+                    spec = rules(str(key), arr)
+                    if spec is not None:
+                        from jax.sharding import NamedSharding
+
+                        return NamedSharding(self.mesh, spec)
+            return self._opt_leaf_sharding(arr)
+
+        return jax.tree_util.tree_map_with_path(leaf, opt_state)
 
     def _place_initial(self):
         if self.mesh is None:
@@ -139,7 +194,8 @@ class TrainStep:
         self.buffers = {k: jax.device_put(v, replicated(self.mesh))
                         for k, v in self.buffers.items()}
         self.opt_state = jax.tree.map(
-            lambda a: jax.device_put(a, self._opt_leaf_sharding(a)), self.opt_state)
+            jax.device_put, self.opt_state,
+            self._opt_state_shardings(self.opt_state))
 
     # -- the pure step -----------------------------------------------------
     def _step_fn(self):
@@ -203,13 +259,16 @@ class TrainStep:
                 gn = jnp.sqrt(sum(jnp.sum(v * v) for v in scaled.values()))
                 factor = jnp.minimum(1.0, self.max_norm / (gn + 1e-12))
                 scaled = {k: v * factor for k, v in scaled.items()}
-            # ZeRO-1: constrain optimizer state onto the data axis so XLA
-            # lowers the gradient collective to reduce-scatter + all-gather
-            if mesh is not None and self.parameter_sync == "sharded":
+            # ZeRO-1/3: constrain optimizer state onto the batch axis so
+            # XLA lowers the gradient collective to reduce-scatter +
+            # all-gather; TP-ruled params' moment buffers follow the TP
+            # layout instead (per-leaf alignment, _opt_state_shardings)
+            if mesh is not None and self.parameter_sync in ("sharded",
+                                                            "fsdp"):
                 opt_state = jax.tree.map(
-                    lambda a: jax.lax.with_sharding_constraint(
-                        a, self._opt_leaf_sharding(a)) if hasattr(a, "ndim") else a,
-                    opt_state)
+                    lambda a, s: jax.lax.with_sharding_constraint(a, s)
+                    if hasattr(a, "ndim") else a,
+                    opt_state, self._opt_state_shardings(opt_state))
             new_params, new_opt = optim.update(scaled, params, opt_state)
             if mesh is not None:
                 new_params = {
