@@ -65,7 +65,7 @@ def test_add_mul_cadd_cmul_constants():
     add = nn.Add(4)
     _c(add.forward(_j(x)), x + np.asarray(add.bias))
     mul = nn.Mul()
-    _c(mul.forward(_j(x)), x * float(np.asarray(mul.weight)))
+    _c(mul.forward(_j(x)), x * float(np.asarray(mul.weight).reshape(())))
     cadd = nn.CAdd((1, 4))
     _c(cadd.forward(_j(x)), x + np.asarray(cadd.bias))
     cmul = nn.CMul((1, 4))

@@ -12,7 +12,7 @@ import bigdl_tpu.nn as nn
 
 
 def _cmp(ours_loss, ours_grad, t_loss, t_grad, tag, rtol=1e-4, atol=1e-5):
-    np.testing.assert_allclose(float(ours_loss), float(t_loss),
+    np.testing.assert_allclose(float(ours_loss), float(t_loss.detach()),
                                rtol=rtol, atol=atol, err_msg=f"{tag} loss")
     np.testing.assert_allclose(np.asarray(ours_grad), t_grad.numpy(),
                                rtol=rtol, atol=atol, err_msg=f"{tag} grad")
