@@ -63,3 +63,20 @@ def test_bench_make_step_applies_graph_passes():
     assert any("+" in n for n in names), "no merged sibling convs in bench model"
     assert any(n.endswith("/s2d") for n in names), "no s2d conv1 in bench model"
     assert x.shape[0] == 2
+
+
+def test_bench_infer_legs_run_and_account():
+    """Both inference legs (bf16, int8-quantized) of the bench's
+    int8-vs-bf16 table run end-to-end and report throughput + op
+    accounting — guards the quantize()+EvalStep+AOT wiring from rot
+    between hardware windows."""
+    import sys
+    sys.path.insert(0, ".")
+    import bench
+
+    for quantized in (False, True):
+        row = bench.run_infer_config("vgg16_cifar10", batch=8, iters=1,
+                                     quantized=quantized)
+        assert row["img_s"] > 0, row
+        # cost accounting present (cpu has no peak, so no utilization)
+        assert "achieved_tops" in row, row
