@@ -38,6 +38,10 @@ def main():
     ap.add_argument("--iters", type=int, default=8)
     ap.add_argument("--zero1", action="store_true",
                     help="use the ZeRO-1 sharded-optimizer layout")
+    ap.add_argument("--sync", default=None,
+                    choices=["allreduce", "sharded", "fsdp"],
+                    help="parameter_sync mode (overrides --zero1; fsdp "
+                         "= ZeRO-3 parameter sharding)")
     args = ap.parse_args()
 
     import jax
@@ -80,7 +84,8 @@ def main():
         step = TrainStep(model, criterion,
                          optim.SGD(learning_rate=0.01, momentum=0.9),
                          mesh=mesh,
-                         parameter_sync="sharded" if args.zero1 else "allreduce",
+                         parameter_sync=args.sync or (
+                             "sharded" if args.zero1 else "allreduce"),
                          compute_dtype=jnp.bfloat16)
         # each process builds its LOCAL rows of the global batch
         # (TrainStep._shard_batch's multi-host contract)
@@ -109,7 +114,8 @@ def main():
         "metric": f"{args.config}_scaling_efficiency",
         "config": args.config,
         "per_chip_batch": per_chip,
-        "parameter_sync": "sharded" if args.zero1 else "allreduce",
+        "parameter_sync": args.sync or (
+            "sharded" if args.zero1 else "allreduce"),
         "efficiency_vs_linear": {
             str(r["chips"]): round(
                 r["images_per_sec"] /
