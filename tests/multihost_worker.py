@@ -80,6 +80,8 @@ def main():
     o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
     if os.environ.get("BIGDL_TEST_ZERO1"):
         o.set_parameter_sync("sharded")
+    if os.environ.get("BIGDL_TEST_FSDP"):
+        o.set_parameter_sync("fsdp")
     if os.environ.get("BIGDL_TEST_SHARDED_VAL"):
         # validation batches round-robin across processes; the merged
         # result must equal the single-process full evaluation

@@ -109,6 +109,15 @@ def test_two_process_zero1_matches_single_process(tmp_path):
     _assert_same_params(mp, sp)
 
 
+def test_two_process_fsdp_matches_single_process(tmp_path):
+    """ZeRO-3: the PARAMETERS shard across the process boundary — no
+    process holds a whole replica — and the trajectory still equals the
+    single-process run (gather_replicated reassembles for the save)."""
+    mp = _run_cluster(tmp_path, "mp_fsdp", BIGDL_TEST_FSDP=1)
+    sp = _run_single(tmp_path, "sp_fsdp")
+    _assert_same_params(mp, sp)
+
+
 def test_two_process_checkpoint_single_writer(tmp_path):
     """Checkpointing on a cluster: every process participates in the
     gathers but only the coordinator writes files."""
