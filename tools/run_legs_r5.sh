@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-5 TPU sweep with a progress watchdog.
+#
+# The axon tunnel wedges per-client and transiently: round-5 contact
+# log shows probe OK -> headline leg OK -> next client wedged inside
+# its first compile RPC (after probe_backend's bounded jax.devices()
+# succeeded, so BENCH_BACKEND_TIMEOUT never fires).  bench.py flushes
+# one stderr line per finished config, so the cheapest resilient
+# protocol is: ONE process for all remaining configs (minimal client
+# churn), watch stderr for progress, and on a stall kill + restart
+# with the configs not yet banked.
+set -u
+cd /root/repo
+OUT=bench_legs_r5.jsonl
+ERR=bench_legs_r5.err
+ALL=${LEGS:-"lenet_mnist vgg16_cifar10 lstm_text lstm_text_large resnet50_imagenet transformer_lm transformer_lm_long"}
+STALL=${STALL:-420}          # s without a new stderr byte -> wedged
+ROUNDS=${ROUNDS:-12}
+
+remaining() {  # configs in $ALL with no "# <name>:" line in $ERR yet
+  local out=""
+  for c in $ALL; do
+    grep -q "^# $c:" "$ERR" 2>/dev/null || out="$out,$c"
+  done
+  echo "${out#,}"
+}
+
+touch "$ERR"
+for round in $(seq 1 "$ROUNDS"); do
+  rem=$(remaining)
+  if [ -z "$rem" ]; then break; fi
+  echo "=== round $round remaining=$rem $(date -u +%H:%M:%S)" >> "$ERR"
+  BENCH_CONFIGS=$rem BENCH_INFER=1 BENCH_ITERS=24 \
+    python bench.py >> "$OUT" 2>> "$ERR" &
+  pid=$!
+  # watchdog: kill on stall, reap on exit
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 20
+    now=$(date +%s); mt=$(stat -c %Y "$ERR")
+    if [ $((now - mt)) -ge "$STALL" ]; then
+      echo "=== round $round STALL (no stderr for ${STALL}s), killing $pid" >> "$ERR"
+      kill -9 "$pid" 2>/dev/null
+      break
+    fi
+  done
+  wait "$pid" 2>/dev/null
+  echo "=== round $round child exit rc=$? $(date -u +%H:%M:%S)" >> "$ERR"
+  rm -f /tmp/bigdl_tpu_u0_axon__p0.lock
+  sleep 45
+done
+# the int8/bf16 inference table only prints inside the FINAL json line of
+# a run that completes; if every train config is banked but no run ended
+# cleanly, one more tiny run picks it up (lenet re-run, cheap)
+echo "ALL_LEGS_DONE remaining='$(remaining)' $(date -u +%H:%M:%S)" >> "$ERR"
