@@ -311,9 +311,16 @@ def _banked_path():
     if os.environ.get("BENCH_BANKED"):
         return os.environ["BENCH_BANKED"]
     import glob
+    import re
 
     here = os.path.dirname(os.path.abspath(__file__))
-    banked = sorted(glob.glob(os.path.join(here, "BENCH_banked_*.json")))
+    banked = glob.glob(os.path.join(here, "BENCH_banked_*.json"))
+
+    def round_no(p):  # numeric sort: r10 must beat r5 (lexical fails)
+        m = re.search(r"_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    banked.sort(key=round_no)
     return banked[-1] if banked else os.path.join(here, "BENCH_banked.json")
 
 
@@ -363,7 +370,11 @@ def _emit_partial_and_die(reason: str):
     """Mid-run wedge with completed configs in hand: emit THOSE (live,
     current data beats any banked artifact), marked incomplete; with
     nothing measured yet, fall back to the banked replay."""
-    done = {k: v for k, v in _live_results.items() if "error" not in v}
+    # snapshot: the main thread may still be inserting into the shared
+    # dict when the watchdog fires (dict-resize during iteration would
+    # kill this daemon thread silently — and with it the bail-out path)
+    snap = dict(_live_results)
+    done = {k: v for k, v in snap.items() if "error" not in v}
     if not done:
         _replay_or(
             {"metric": "backend_wedged_midrun", "value": None,
@@ -376,7 +387,7 @@ def _emit_partial_and_die(reason: str):
         "value": head.get("images_per_sec"), "unit": "images/sec",
         "vs_baseline": None, "mfu": head.get("mfu"),
         "source": _source_state(), "incomplete": True,
-        "wedged": reason, "configs": _live_results}))
+        "wedged": reason, "configs": snap}))
     sys.stdout.flush()
     os._exit(3)
 

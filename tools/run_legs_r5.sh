@@ -13,7 +13,7 @@ set -u
 cd /root/repo
 OUT=bench_legs_r5.jsonl
 ERR=bench_legs_r5.err
-ALL=${LEGS:-"lenet_mnist vgg16_cifar10 lstm_text lstm_text_large resnet50_imagenet transformer_lm transformer_lm_long"}
+ALL=${LEGS:-"inception_v1_imagenet lenet_mnist vgg16_cifar10 lstm_text lstm_text_large resnet50_imagenet transformer_lm transformer_lm_long"}
 STALL=${STALL:-420}          # s without a new stderr byte -> wedged
 ROUNDS=${ROUNDS:-12}
 
@@ -30,7 +30,10 @@ for round in $(seq 1 "$ROUNDS"); do
   rem=$(remaining)
   if [ -z "$rem" ]; then break; fi
   echo "=== round $round remaining=$rem $(date -u +%H:%M:%S)" >> "$ERR"
-  BENCH_CONFIGS=$rem BENCH_INFER=1 BENCH_ITERS=24 \
+  # singleton wait bounded BELOW the stall watchdog: a held lock must
+  # surface as bench's own conflict error line, not be misread as a
+  # wedge when /tmp/TPU_BACK's 3700s harvest default kicks in
+  BENCH_CONFIGS=$rem BENCH_INFER=1 BENCH_ITERS=24 BIGDL_SINGLETON_WAIT=210 \
     python bench.py >> "$OUT" 2>> "$ERR" &
   pid=$!
   # watchdog: kill on stall, reap on exit

@@ -43,13 +43,20 @@ print('OK', devs)
   rc=$?
   echo "$ts rc=$rc $(tail -1 <<<"$out")" >> "$LOG"
   if [ "$rc" -eq 0 ]; then
-    echo "$ts TPU BACK — running bench sweep" >> "$LOG"
+    echo "$ts TPU BACK — running banked leg sweep" >> "$LOG"
     touch /tmp/TPU_BACK
-    # explicit short claim wait: the watcher itself holds nothing here,
-    # so a held lock means a stray second driver — fail fast with the
-    # JSON error rather than waiting into our own 3600s timeout
-    if BIGDL_SINGLETON_WAIT=210 timeout -k 30 3600 python bench.py > "$REPO/BENCH_watch.json" 2>> "$LOG"; then
-      echo "$(date -u +%H:%M:%S) bench sweep done -> BENCH_watch.json" >> "$LOG"
+    # per-config banked sweep (tools/run_legs_r5.sh): bench.py flushes a
+    # stderr line per finished config, the runner retries wedged clients
+    # with a stall watchdog, and the assembler merges everything banked
+    # so far — a mid-sweep wedge can no longer erase finished configs
+    # (the round-5 failure mode: tunnel wedges per-client, transiently,
+    # AFTER a successful probe, inside the first remote-compile RPC)
+    timeout -k 30 14400 bash tools/run_legs_r5.sh >> "$LOG" 2>&1
+    python tools/assemble_legs.py > "$REPO/BENCH_watch.json" 2>> "$LOG"
+    # top-level "error" only — a per-config error row inside "configs"
+    # must not fail an otherwise good assembly
+    if python -c "import json,sys; d=json.load(open('$REPO/BENCH_watch.json')); sys.exit(1 if 'error' in d else 0)" 2>>"$LOG"; then
+      echo "$(date -u +%H:%M:%S) banked sweep assembled -> BENCH_watch.json" >> "$LOG"
       # harvest the REST of the runbook (docs/tpu_runbook.md) while the
       # chip answers: profiles, real-data ingest, A/B experiments, TTA.
       # Each leg bounded + logged; failures don't stop later legs.
