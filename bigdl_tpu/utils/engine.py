@@ -33,7 +33,40 @@ import numpy as np
 
 from bigdl_tpu.utils.config import get_config
 
-__all__ = ["Engine", "honor_platform_request"]
+__all__ = ["Engine", "honor_platform_request", "enable_compile_cache"]
+
+
+def enable_compile_cache(path: str = None) -> str:
+    """Turn on JAX's persistent executable cache (no-op if already set).
+
+    Re-runs then LOAD the serialized executable instead of re-compiling
+    — which besides the usual compile-latency win matters doubly under a
+    remote-compile device tunnel (``PALLAS_AXON_REMOTE_COMPILE=1``):
+    the compile RPC is the tunnel's observed wedge point, and a cache
+    hit skips that RPC entirely.  Reference analogue: the engine-level
+    environment bootstrap in ``utils/Engine.scala:165`` owns
+    process-wide runtime knobs the same way.
+
+    ``path`` defaults to ``BIGDL_COMPILE_CACHE`` (set to ``0``/empty to
+    disable) else ``~/.cache/bigdl_tpu/xla``.  Returns the directory
+    (or ``""`` when disabled)."""
+    env = os.environ.get("BIGDL_COMPILE_CACHE")
+    if env is not None and env.strip() in ("", "0", "off", "false"):
+        return ""
+    path = path or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "bigdl_tpu", "xla")
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:  # user already configured
+        return jax.config.jax_compilation_cache_dir
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every executable that took real compile work (the default
+    # 1s floor skips little probe programs whose wedge-window removal
+    # is exactly what we want)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
 
 
 def honor_platform_request() -> None:

@@ -17,19 +17,28 @@ ALL=${LEGS:-"inception_v1_imagenet lenet_mnist vgg16_cifar10 lstm_text lstm_text
 STALL=${STALL:-420}          # s without a new stderr byte -> wedged
 ROUNDS=${ROUNDS:-12}
 
-remaining() {  # configs in $ALL with no "# <name>:" line in $ERR yet
+remaining() {  # configs in $ALL with no REAL measurement in $ERR yet
+  # (an '{'error': ...}' row is retryable — only an images_per_sec row
+  # banks the config)
   local out=""
   for c in $ALL; do
-    grep -q "^# $c:" "$ERR" 2>/dev/null || out="$out,$c"
+    grep -q "^# $c: {'images_per_sec'" "$ERR" 2>/dev/null || out="$out,$c"
   done
   echo "${out#,}"
 }
+
+# a timeout on this wrapper must not orphan the measured child (it holds
+# the device client + singleton flock)
+pid=""
+trap '[ -n "$pid" ] && kill -9 "$pid" 2>/dev/null' EXIT TERM INT
 
 touch "$ERR"
 for round in $(seq 1 "$ROUNDS"); do
   rem=$(remaining)
   if [ -z "$rem" ]; then break; fi
-  echo "=== round $round remaining=$rem $(date -u +%H:%M:%S)" >> "$ERR"
+  # the commit stamp lets the assembler attribute each banked row to the
+  # tree that measured it (bench._source_state's lesson)
+  echo "=== round $round commit=$(git rev-parse --short HEAD 2>/dev/null)$(git diff --quiet 2>/dev/null || echo -dirty) remaining=$rem $(date -u +%H:%M:%S)" >> "$ERR"
   # singleton wait bounded BELOW the stall watchdog: a held lock must
   # surface as bench's own conflict error line, not be misread as a
   # wedge when /tmp/TPU_BACK's 3700s harvest default kicks in
