@@ -39,11 +39,7 @@ from bigdl_tpu.utils.engine import enable_compile_cache
 # tunnel's observed wedge point
 enable_compile_cache()
 
-HEADLINE = "inception_v1_imagenet"
-
-#: best round-3 measured headline (BASELINE.md) — progress denominator
-#: shared with tools/assemble_legs.py
-ROUND3_BEST = 4853.0
+from bench_constants import HEADLINE, ROUND3_BEST  # shared with tooling
 
 #: peak dense bf16 TFLOP/s per chip (public spec sheets)
 PEAK_TFLOPS = {

@@ -1,0 +1,10 @@
+"""Dependency-free constants shared by bench.py and the harvest tooling
+(tools/assemble_legs.py must stay importable without jax — it is a log
+parser the watcher's live-progress gate depends on)."""
+
+#: the north-star config (BASELINE.json)
+HEADLINE = "inception_v1_imagenet"
+
+#: best round-3 measured headline throughput (BASELINE.md) — the
+#: progress denominator for ``vs_round3_best``
+ROUND3_BEST = 4853.0

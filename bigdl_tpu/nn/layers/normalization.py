@@ -12,6 +12,7 @@ reference's in-place ``runningMean``/``runningVar`` updates.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -28,6 +29,80 @@ __all__ = [
     "SpatialDivisiveNormalization", "SpatialSubtractiveNormalization",
     "Normalize", "Dropout", "L1Penalty",
 ]
+
+
+def _bn_reduce_count(x, axes):
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    return n
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_train_apply(axes, eps, x, weight, bias):
+    """Fused training batch-norm with hand-written VJP.
+
+    TPU profile finding (round 5, ResNet-50): the autodiff of the naive
+    ``mean``/``var`` two-pass form lowered to a pile of per-channel
+    reduce fusions with bf16 accumulators at ~25% of the train step.
+    This version makes the minimum number of passes — ONE fused
+    sum/sum-of-squares read forward (f32 accumulation), ONE fused
+    dbeta/dgamma read backward, and the standard fused dx formula — and
+    keeps every reduction in f32.  Semantics follow
+    ``nn/BatchNormalization.scala:269`` (biased var for normalization).
+
+    Returns ``(out, mean, var)``; mean/var are f32 for the caller's
+    running-stat buffers (stop-gradient them — their cotangents are
+    ignored by the VJP, which is correct only for buffer use)."""
+    out, mean, var, _ = _bn_train_fwd_impl(axes, eps, x, weight, bias)
+    return out, mean, var
+
+
+def _bn_train_fwd_impl(axes, eps, x, weight, bias):
+    n = _bn_reduce_count(x, axes)
+    s1 = jnp.sum(x, axis=axes, dtype=jnp.float32)
+    # the f32 convert fuses into the reduce read (no materialized copy);
+    # squaring in bf16 would cost ~3 mantissa bits on the stats
+    s2 = jnp.sum(lax.square(x.astype(jnp.float32)), axis=axes,
+                 dtype=jnp.float32)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - lax.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    for a in range(x.ndim):
+        if a not in axes:
+            shape[a] = x.shape[a]
+    scale = (inv * weight).reshape(shape).astype(x.dtype)
+    shift = (bias - mean * inv * weight).reshape(shape).astype(x.dtype)
+    out = x * scale + shift
+    return out, mean, var, inv
+
+
+def _bn_train_vjp_fwd(axes, eps, x, weight, bias):
+    out, mean, var, inv = _bn_train_fwd_impl(axes, eps, x, weight, bias)
+    return (out, mean, var), (x, weight, mean, inv)
+
+
+def _bn_train_vjp_bwd(axes, eps, res, cotangents):
+    gy, _gmean, _gvar = cotangents  # stat cotangents: buffer-only outputs
+    x, weight, mean, inv = res
+    n = _bn_reduce_count(x, axes)
+    shape = [1] * x.ndim
+    for a in range(x.ndim):
+        if a not in axes:
+            shape[a] = x.shape[a]
+    gy32 = gy.astype(jnp.float32)
+    xhat32 = (x.astype(jnp.float32) - mean.reshape(shape)) \
+        * inv.reshape(shape)
+    dbeta = jnp.sum(gy32, axis=axes, dtype=jnp.float32)
+    dgamma = jnp.sum(gy32 * xhat32, axis=axes, dtype=jnp.float32)
+    k = (weight * inv).reshape(shape)
+    dx = (k * (gy32 - (dbeta / n).reshape(shape)
+               - xhat32 * (dgamma / n).reshape(shape))).astype(x.dtype)
+    return dx, dgamma.astype(weight.dtype), dbeta.astype(weight.dtype)
+
+
+_bn_train_apply.defvjp(_bn_train_vjp_fwd, _bn_train_vjp_bwd)
 
 
 class BatchNormalization(Module):
@@ -62,14 +137,19 @@ class BatchNormalization(Module):
         shape = [1] * input.ndim
         shape[self._feature_axis] = self.n_output
         if self.training:
-            mean = jnp.mean(input, axis=axes)
-            var = jnp.var(input, axis=axes)
+            w = self.weight if self.affine \
+                else jnp.ones((self.n_output,), jnp.float32)
+            b = self.bias if self.affine \
+                else jnp.zeros((self.n_output,), jnp.float32)
+            out, mean, var = _bn_train_apply(axes, self.eps, input, w, b)
+            mean = lax.stop_gradient(mean)
+            var = lax.stop_gradient(var)
             n = input.size // self.n_output
             unbiased = var * n / max(n - 1, 1)
             self.running_mean = (1 - self.momentum) * self.running_mean + self.momentum * mean
             self.running_var = (1 - self.momentum) * self.running_var + self.momentum * unbiased
-        else:
-            mean, var = self.running_mean, self.running_var
+            return out
+        mean, var = self.running_mean, self.running_var
         inv = lax.rsqrt(var + self.eps).reshape(shape)
         out = (input - mean.reshape(shape)) * inv
         if self.affine:

@@ -22,8 +22,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 # shared schema constants — the assembled line must not silently diverge
-# from bench.py's own (importing bench pulls jax but touches no backend)
-from bench import HEADLINE, ROUND3_BEST  # noqa: E402
+# from bench.py's own (bench_constants is dependency-free: this parser
+# must work without jax and without bench's import side effects)
+from bench_constants import HEADLINE, ROUND3_BEST  # noqa: E402
 
 _CFG = re.compile(r"^# ([a-z0-9_]+): (\{.*\})\s*$")
 _INFER = re.compile(r"^# infer ([a-z0-9_]+): (\{.*\})\s*$")

@@ -28,9 +28,11 @@ remaining() {  # configs in $ALL with no REAL measurement in $ERR yet
 }
 
 # a timeout on this wrapper must not orphan the measured child (it holds
-# the device client + singleton flock)
+# the device client + singleton flock) — and a TERM/INT must END the
+# sweep, not let the loop respawn a fresh client
 pid=""
-trap '[ -n "$pid" ] && kill -9 "$pid" 2>/dev/null' EXIT TERM INT
+trap '[ -n "$pid" ] && kill -9 "$pid" 2>/dev/null' EXIT
+trap '[ -n "$pid" ] && kill -9 "$pid" 2>/dev/null; exit 143' TERM INT
 
 touch "$ERR"
 for round in $(seq 1 "$ROUNDS"); do

@@ -58,7 +58,9 @@ print('OK', devs)
     [ -s "$REPO/bench_legs_r5.err" ] && \
       mv "$REPO/bench_legs_r5.err" "$REPO/bench_watch/legs_$(date -u +%m%d_%H%M).err"
     timeout -k 30 14400 bash tools/run_legs_r5.sh >> "$LOG" 2>&1
-    banked=$(grep -c "^# .*images_per_sec" "$REPO/bench_legs_r5.err" 2>/dev/null || echo 0)
+    # NB: grep -c prints 0 itself on no-match (exit 1) — no || echo,
+    # which would yield the two-line string "0\n0"
+    banked=$(grep -c "^# .*images_per_sec" "$REPO/bench_legs_r5.err" 2>/dev/null); banked=${banked:-0}
     python tools/assemble_legs.py > "$REPO/BENCH_watch.json" 2>> "$LOG"
     # proceed only on LIVE progress: >=1 newly banked row this cycle and
     # a clean assembly (top-level "error" only — a per-config error row
