@@ -50,7 +50,10 @@ class LayerNorm(Module):
 class MultiHeadAttention(Module):
     """Multi-head attention over [batch, seq, embed] inputs.
 
-    ``backend``: 'auto' (flash on TPU, dense elsewhere), 'dense',
+    ``backend``: 'auto' (on TPU: flash when ``max(Sq, Sk)`` reaches
+    ``bigdl_tpu.ops.attention.flash_min_seq()`` — default 1024, env
+    ``BIGDL_FLASH_MIN_SEQ`` — else dense, which at short sequence is one
+    batched MXU matmul; always dense off-TPU), 'dense',
     'flash', or a callable ``f(q, k, v) -> out`` over [B, H, S, D] arrays
     with causal/scale baked in — e.g. a shard_map-wrapped ring/ulysses
     attention from
@@ -106,9 +109,17 @@ class MultiHeadAttention(Module):
                 "backend='flash' does not support masks (only causal=True); "
                 "use backend='dense' or 'auto' for masked attention")
         if backend == "auto":
-            from bigdl_tpu.ops.attention import is_tpu_device
+            from bigdl_tpu.ops.attention import flash_min_seq, is_tpu_device
 
-            backend = "flash" if (is_tpu_device() and mask is None) \
+            # dense below the threshold: one big batched MXU matmul
+            # beats the per-head flash tiles there (round-5 profile:
+            # flash was 53% of the seq-512 transformer step); flash
+            # above it, where the Sq x Sk score tensor pressures HBM —
+            # judged on BOTH lengths so a short-query cross-attention
+            # over a long k/v still streams
+            backend = "flash" if (is_tpu_device() and mask is None
+                                  and max(q.shape[2], k.shape[2])
+                                  >= flash_min_seq()) \
                 else "dense"
         if backend == "flash":
             return flash_attention(q, k, v, causal=self.causal)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -29,6 +30,7 @@ from jax import lax
 __all__ = [
     "dot_product_attention",
     "flash_attention",
+    "flash_min_seq",
     "is_tpu_device",
     "attention_partial",
     "combine_partials",
@@ -117,6 +119,27 @@ def is_tpu_device() -> bool:
 def _use_interpret() -> bool:
     """Mosaic-compile on TPU; Pallas interpret mode elsewhere (tests)."""
     return not is_tpu_device()
+
+
+def flash_min_seq() -> int:
+    """Sequence length at which ``backend='auto'`` switches from dense
+    to flash attention (``BIGDL_FLASH_MIN_SEQ``, default 1024).
+
+    Round-5 TPU v5e profile: at seq 512 the Pallas flash fwd+bwd pair
+    consumed 53% of the transformer_lm train step — the per-head
+    (block_q x d=64 x block_k) tiles underfill the 128x128 MXU and the
+    grid iteration cost dominates — while dense attention is one large
+    batched matmul XLA maps straight onto the MXU.  Flash's O(S) memory
+    only pays above the threshold where the S^2 score tensor starts to
+    pressure HBM (seq 4096 long-context config: 1 GB+)."""
+    raw = os.environ.get("BIGDL_FLASH_MIN_SEQ", "1024")
+    try:
+        return int(raw)
+    except ValueError as e:
+        # loud: a silently-defaulted threshold would make an A/B sweep
+        # compare the wrong legs
+        raise ValueError(
+            f"BIGDL_FLASH_MIN_SEQ={raw!r} is not an integer") from e
 
 
 # Grid layout: (batch*heads, q_blocks, k_blocks) for fwd/dq and

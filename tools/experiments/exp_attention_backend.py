@@ -1,0 +1,57 @@
+"""A/B: dense vs Pallas-flash attention on the transformer_lm train
+step (seq 512) and, budget permitting, transformer_lm_long (seq 4096).
+
+Round-5 TPU profile motivated the `flash_min_seq` gate: flash fwd+bwd
+was 53% of the seq-512 step.  This experiment measures both backends
+end-to-end so the threshold default is a recorded decision, not a
+profile inference.  BIGDL_FLASH_MIN_SEQ=0 forces flash; a huge value
+forces dense.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+ITERS = int(os.environ.get("EXP_ITERS", "12"))
+CONFIGS = os.environ.get("EXP_CONFIGS", "transformer_lm").split(",")
+
+from bigdl_tpu.ops.attention import is_tpu_device  # noqa: E402
+
+if not is_tpu_device():
+    # off-TPU the auto gate always picks dense — both legs would measure
+    # the same path and record a meaningless "decision"
+    print("SKIP: not on TPU hardware; dense-vs-flash A/B needs the chip",
+          flush=True)
+    sys.exit(0)
+
+
+def run(config, min_seq):
+    os.environ["BIGDL_FLASH_MIN_SEQ"] = str(min_seq)
+    import bench
+
+    step, x, y = bench.make_step(config)
+    step.aot_scan(x, y, jax.random.key(0), ITERS)
+    drain = bench.make_drain(step)
+    step.run_scan(x, y, jax.random.key(1), ITERS)
+    drain()
+    t0 = time.perf_counter()
+    step.run_scan(x, y, jax.random.key(2), ITERS)
+    drain()
+    wall = time.perf_counter() - t0
+    n = x.shape[0] * ITERS
+    return n / wall
+
+
+for config in CONFIGS:
+    for tag, min_seq in (("dense", 10**9), ("flash", 0)):
+        try:
+            rate = run(config, min_seq)
+            print(f"{config} {tag}: {rate:.1f} seq/s", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{config} {tag}: ERROR {type(e).__name__}: {e}",
+                  flush=True)
