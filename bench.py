@@ -181,6 +181,24 @@ def make_drain(step):
     return drain
 
 
+def _compile_heartbeat(name, stop_event, max_s=1200.0):
+    """Stderr heartbeat while a (legitimately slow) compile is in
+    flight, so the leg runner's stall watchdog doesn't kill a healthy
+    heavy-compile config (lstm_text_large / transformer_lm_long).
+    BOUNDED: after ``max_s`` the heartbeat stops, so a wedged compile
+    RPC still stalls out rather than being kept alive forever."""
+    t0 = time.monotonic()
+    while not stop_event.wait(60.0):
+        dt = time.monotonic() - t0
+        if dt > max_s:
+            return
+        # also feed the in-process wedge watchdog: a legit heavy compile
+        # is progress; the bound above keeps a wedged RPC mortal
+        _last_progress[0] = time.monotonic()
+        print(f"# compiling {name}: {dt:.0f}s", file=sys.stderr,
+              flush=True)
+
+
 def run_config(name, batch, iters):
     step, x, y = make_step(name, batch)
 
@@ -189,9 +207,18 @@ def run_config(name, batch, iters):
     # the training program, and a real TPU deployment amortizes it the
     # same way.  The AOT compile also yields XLA's cost analysis (scan
     # body counted once).
+    import threading
+
     flops = None
     t_c0 = time.perf_counter()
-    cost = step.aot_scan(x, y, jax.random.key(0), iters)
+    stop_hb = threading.Event()
+    hb = threading.Thread(target=_compile_heartbeat, args=(name, stop_hb),
+                          daemon=True)
+    hb.start()
+    try:
+        cost = step.aot_scan(x, y, jax.random.key(0), iters)
+    finally:
+        stop_hb.set()
     compile_s = time.perf_counter() - t_c0
     if cost and cost.get("flops"):
         flops = float(cost["flops"])
