@@ -212,6 +212,16 @@ class _PoolBase(Module):
         if self.tie_split and taps <= self._TIE_SPLIT_MAX_TAPS \
                 and jnp.issubdtype(x.dtype, jnp.floating):
             return _maxpool_tie_split(x, dims, strides, tuple(pads))
+        if not self.tie_split:
+            from bigdl_tpu.ops.pooling_pallas import (
+                maxpool_argmax, pallas_pool_supported)
+            if pallas_pool_supported(x, dims, strides, pads):
+                # Pallas argmax-index kernel: same first-argmax tie
+                # semantics as select-and-scatter, but the backward
+                # scatters from a saved int8 tap index instead of
+                # re-reading x and y (round-5 profile: the re-read was
+                # ~28% of the Inception-v1 step)
+                return maxpool_argmax(x, dims, strides, tuple(pads))
         return lax.reduce_window(x, _max_init(x.dtype), lax.max, dims, strides, pads)
 
     def _avg(self, x, count_include_pad: bool, divide: bool = True):

@@ -1,0 +1,296 @@
+"""Pallas TPU max-pool with an argmax-index backward.
+
+Why this kernel exists (round-5 TPU profile, Inception-v1 train step):
+XLA's select-and-scatter backward — the best of the three maxpool
+gradients measured so far (BASELINE.md round-3 table) — still re-reads
+the full input activation AND the pool output to locate each window's
+first argmax: ~21.5% of the step in select_and_scatter fusions plus
+~7.1% in the compare/select index path, all of it HBM-bound traffic
+over tensors like the [256,64,112,112] first-pool activation.
+
+This kernel removes the re-read.  The forward computes the max and the
+*winning tap index* (0..kh*kw-1, int8) in one pass over the input; the
+backward then scatters gy straight from (gy, idx) — it never touches x
+or y again:
+
+    select-and-scatter bwd traffic:  read x + read y + read gy + write dx
+    argmax-index bwd traffic:        read gy + read idx(+1/8 size) + write dx
+
+Both passes run as one Pallas grid over N*C row-blocks with the whole
+(H, W) plane resident in VMEM, so the residue-class interleave that made
+the pure-XLA gather backward slow (an extra HBM relayout pass) happens
+in-register instead.
+
+Semantics: first-argmax tie-breaking in lexicographic (kh, kw) tap
+order — bit-parity with the reference's CPU loop
+(``nn/NNPrimitive.scala:594-972``, rows then cols) and with XLA's
+select-and-scatter lowering, asserted in ``tests/test_pooling_pallas.py``.
+
+Off-TPU the kernel runs in Pallas interpret mode so the CPU test mesh
+exercises the identical code path.  ``BIGDL_POOL_KERNEL=off`` falls back
+to select-and-scatter (the measured round-3 default).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from bigdl_tpu.ops.attention import is_tpu_device
+
+__all__ = ["maxpool_argmax", "pallas_pool_supported"]
+
+_NEG = float("-inf")
+
+#: unrolled taps beyond this would bloat compile time (same cap as the
+#: tie-split VJP in nn/layers/pooling.py)
+_MAX_TAPS = 64
+
+#: per-block VMEM budget (bytes); conservative vs the 16 MB/core arena
+_VMEM_BUDGET = 6 * 1024 * 1024
+
+
+def pallas_pool_supported(x, dims, strides, pads) -> bool:
+    """True when (x, window) fits this kernel: 4-D NCHW input, window on
+    the trailing two axes only, float dtype, bounded tap count, and a
+    single (H, W) plane that fits the per-block VMEM budget."""
+    mode = os.environ.get("BIGDL_POOL_KERNEL", "auto")
+    if mode == "off":
+        return False
+    if x.ndim != 4 or not jnp.issubdtype(x.dtype, jnp.floating):
+        return False
+    if dims[0] != 1 or dims[1] != 1 or strides[0] != 1 or strides[1] != 1:
+        return False  # pooled axes must be the trailing (H, W) pair
+    if pads[0] != (0, 0) or pads[1] != (0, 0):
+        return False
+    kh, kw = dims[2], dims[3]
+    if kh * kw > _MAX_TAPS or kh < 1 or kw < 1:
+        return False
+    h, w = x.shape[2], x.shape[3]
+    sh, sw = strides[2], strides[3]
+    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, (pads[2], pads[3]))
+    esz = jnp.dtype(x.dtype).itemsize
+    # the single-row footprint must fit the budget even at bb=1
+    if _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz) > _VMEM_BUDGET:
+        return False  # fall back to reduce_window / select-and-scatter
+    if mode == "auto":
+        # OPT-IN until the Mosaic lowering is proven on hardware: the
+        # first on-chip compile (round 5) rejected the strided tap
+        # extraction (vector.extract_strided_slice strides must be 1),
+        # so "auto" currently means off; flip after the stride-free
+        # formulation A/Bs a win (tools/experiments/exp_pool_kernel.py).
+        # NB gate on is_tpu_device(), not jax.default_backend() ==
+        # "tpu": proxied PJRT plugins (axon) register under their own
+        # platform name — the round-4 flash-attention gating bug.
+        return False
+    return True  # "interpret" / "on": run everywhere (tests)
+
+
+def _use_interpret() -> bool:
+    if os.environ.get("BIGDL_POOL_KERNEL") == "interpret":
+        return True
+    return not is_tpu_device()
+
+
+def _geometry(h: int, w: int, kh: int, kw: int, sh: int, sw: int,
+              pads: Tuple[Tuple[int, int], Tuple[int, int]]):
+    """Padded extents, residue-class lengths, output sizes."""
+    (lo_h, hi_h), (lo_w, hi_w) = pads
+    ph, pw = lo_h + h + hi_h, lo_w + w + hi_w
+    ho, wo = (ph - kh) // sh + 1, (pw - kw) // sw + 1
+    lh, lw = -(-ph // sh), -(-pw // sw)  # ceil
+    return ho, wo, lh, lw
+
+
+def _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz) -> int:
+    """Upper-bound VMEM footprint per N*C row — shared by the support
+    gate and both kernel launchers so they can never drift apart.  The
+    2x padded-plane term covers the backward's residue parts + stacked
+    interleave (the forward's xb + phase copies fit under the same
+    bound)."""
+    return (h * w + 2 * (lh * sh) * (lw * sw)) * esz \
+        + ho * wo * (esz + 1 + 4)
+
+
+def _pick_block(b: int, row_bytes: int) -> int:
+    """Largest divisor of b keeping the block under the VMEM budget."""
+    best = 1
+    for cand in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if b % cand == 0 and cand * row_bytes <= _VMEM_BUDGET:
+            best = cand
+            break
+    return best
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: x -> (y, idx)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, y_ref, idx_ref, *, kh, kw, sh, sw, pads, ho, wo,
+                lh, lw):
+    x = x_ref[...]
+    (lo_h, _), (lo_w, _) = pads
+    hp, wp = lh * sh, lw * sw
+    xb = jnp.pad(x, ((0, 0), (lo_h, hp - lo_h - x.shape[1]),
+                     (lo_w, wp - lo_w - x.shape[2])),
+                 constant_values=_NEG)
+    bb = x.shape[0]
+    # phase-split ONCE (Mosaic rejects strided slices — stride must be
+    # 1 in vector.extract_strided_slice — so decimation happens via
+    # reshape splits + scalar index, verified to lower): phase[rh][rw]
+    # holds padded positions (sh*a + rh, sw*b + rw)
+    phases = []
+    r4 = xb.reshape(bb, lh, sh, wp)
+    for rh in range(sh):
+        row_plane = r4[:, :, rh, :].reshape(bb, lh, lw, sw)
+        phases.append([row_plane[:, :, :, rw] for rw in range(sw)])
+
+    best = jnp.full((bb, ho, wo), _NEG, x.dtype)
+    idx = jnp.zeros((bb, ho, wo), jnp.int32)
+    t = 0
+    for dh in range(kh):
+        rh, jh = dh % sh, dh // sh
+        for dw in range(kw):
+            rw, jw = dw % sw, dw // sw
+            # tap (dh, dw) at output (o_h, o_w) reads padded position
+            # (sh*(o_h + jh) + rh, ...): a stride-1 window of the phase
+            v = phases[rh][rw][:, jh:jh + ho, jw:jw + wo]
+            # strict >: a later equal tap never steals -> first argmax.
+            # NaN taps must still win (reduce_window propagates NaN; a
+            # silent NaN->-inf would hide a diverged run)
+            take = (v > best) | jnp.isnan(v)
+            best = jnp.where(take, v, best)
+            idx = jnp.where(take, t, idx)
+            t += 1
+    y_ref[...] = best
+    idx_ref[...] = idx.astype(idx_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward kernel: (gy, idx) -> dx
+# ---------------------------------------------------------------------------
+
+def _bwd_kernel(gy_ref, idx_ref, dx_ref, *, kh, kw, sh, sw, pads, h, w,
+                lh, lw):
+    gy = gy_ref[...]
+    idx = idx_ref[...].astype(jnp.int32)
+    bb, ho, wo = gy.shape
+    (lo_h, _), (lo_w, _) = pads
+
+    # residue-class accumulation entirely in VMEM: padded position
+    # p = s*a + r receives gy[a - j] from tap d = r + s*j
+    parts = []
+    for rh in range(sh):
+        row = []
+        for rw in range(sw):
+            acc = jnp.zeros((bb, lh, lw), gy.dtype)
+            for jh in range(-(-(kh - rh) // sh)):
+                dh = rh + sh * jh
+                if dh >= kh:
+                    continue
+                for jw in range(-(-(kw - rw) // sw)):
+                    dw = rw + sw * jw
+                    if dw >= kw:
+                        continue
+                    t = dh * kw + dw
+                    g = jnp.where(idx == t, gy, jnp.zeros((), gy.dtype))
+                    nh, nw = min(ho, lh - jh), min(wo, lw - jw)
+                    g = g[:, :nh, :nw]
+                    # static pad to the residue grid (Mosaic-friendlier
+                    # than an in-place strided update)
+                    g = jnp.pad(g, ((0, 0), (jh, lh - jh - nh),
+                                    (jw, lw - jw - nw)))
+                    acc = acc + g
+            row.append(acc)
+        parts.append(row)
+
+    if sh == 1 and sw == 1:
+        dxp = parts[0][0]
+    else:
+        # interleave the residue grids: [bb, lh, sh, lw, sw] -> [bb, lh*sh, lw*sw]
+        stacked = jnp.stack([jnp.stack(row, axis=-1) for row in parts], axis=2)
+        dxp = stacked.reshape(bb, lh * sh, lw * sw)
+    dx_ref[...] = lax.slice(dxp, (0, lo_h, lo_w),
+                            (bb, lo_h + h, lo_w + w))
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper
+# ---------------------------------------------------------------------------
+
+def maxpool_argmax(x, dims, strides, pads):
+    """Max pooling over the trailing (H, W) axes of an NCHW tensor with
+    first-argmax gradient routing via a saved int8 tap index.  Drop-in
+    for ``lax.reduce_window(max)`` under the support predicate
+    ``pallas_pool_supported``."""
+    return _pool(x, dims, strides, tuple(pads), x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _pool(x, dims, strides, pads, xshape):
+    # undifferentiated primal (inference/eval): plain reduce_window —
+    # identical values, fully XLA-fusable, no wasted idx write.  The
+    # Pallas (y, idx) forward runs only under differentiation (_vjp_fwd).
+    return lax.reduce_window(x, _NEG, lax.max, dims, strides, pads)
+
+
+def _fwd_impl(x, dims, strides, pads):
+    n, c, h, w = x.shape
+    kh, kw, sh, sw = dims[2], dims[3], strides[2], strides[3]
+    hw_pads = (pads[2], pads[3])
+    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, hw_pads)
+    b = n * c
+    xr = x.reshape(b, h, w)
+    esz = x.dtype.itemsize
+    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz))
+    kern = functools.partial(_fwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
+                             pads=hw_pads, ho=ho, wo=wo, lh=lh, lw=lw)
+    y, idx = pl.pallas_call(
+        kern,
+        grid=(b // bb,),
+        in_specs=[pl.BlockSpec((bb, h, w), lambda i: (i, 0, 0))],
+        out_specs=[pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, ho, wo), x.dtype),
+                   jax.ShapeDtypeStruct((b, ho, wo), jnp.int8)],
+        interpret=_use_interpret(),
+    )(xr)
+    return y.reshape(n, c, ho, wo), idx
+
+
+def _vjp_fwd(x, dims, strides, pads, xshape):
+    y, idx = _fwd_impl(x, dims, strides, pads)
+    return y, idx
+
+
+def _vjp_bwd(dims, strides, pads, xshape, idx, gy):
+    n, c, h, w = xshape
+    x_dtype = gy.dtype
+    kh, kw, sh, sw = dims[2], dims[3], strides[2], strides[3]
+    hw_pads = (pads[2], pads[3])
+    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, hw_pads)
+    b = n * c
+    gyr = gy.reshape(b, ho, wo)
+    esz = jnp.dtype(x_dtype).itemsize
+    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz))
+    kern = functools.partial(_bwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
+                             pads=hw_pads, h=h, w=w, lh=lh, lw=lw)
+    dx = pl.pallas_call(
+        kern,
+        grid=(b // bb,),
+        in_specs=[pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((bb, h, w), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w), x_dtype),
+        interpret=_use_interpret(),
+    )(gyr, idx)
+    return (dx.reshape(n, c, h, w),)
+
+
+_pool.defvjp(_vjp_fwd, _vjp_bwd)

@@ -85,7 +85,13 @@ def main(argv):
     try:
         with open(out_path) as f:
             prev = json.load(f)
+        prev_commit = (prev.get("source") or {}).get("commit")
         for name, row in (prev.get("configs") or {}).items():
+            # rows written before per-row stamping carry no "commit";
+            # attribute them to the prior artifact's top-level stamp so
+            # a merged best row never surfaces with null provenance
+            if "commit" not in row and prev_commit:
+                row["commit"] = prev_commit
             old = configs.get(name)
             if old is None or ("error" in old and "error" not in row) or \
                     (row.get("images_per_sec", -1)

@@ -1,0 +1,53 @@
+"""A/B: Pallas argmax-index maxpool kernel vs XLA select-and-scatter, on
+the Inception-v1 train step (the kernel's target: pool backward was ~28%
+of the round-5 TPU profile between select_and_scatter and the
+compare/select index path).
+
+Runs the full train step both ways and, if the kernel path fails to
+Mosaic-compile, reports that instead of crashing the harvest.
+"""
+import os, sys, time, traceback
+sys.path.insert(0, '/root/repo')
+import jax, jax.numpy as jnp, numpy as np
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.nn.fuse import optimize_for_tpu
+from bigdl_tpu.models.inception import build_inception_v1
+from bigdl_tpu.parallel.train_step import TrainStep
+from bigdl_tpu.utils.rng import RNG
+
+ITERS = 16
+rng = np.random.default_rng(0)
+
+
+def run(tag):
+    RNG.set_seed(0)
+    model = optimize_for_tpu(build_inception_v1(1000))
+    step = TrainStep(model, nn.ClassNLLCriterion(),
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+    x = jnp.asarray(rng.normal(size=(256, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, 256))
+    step.aot_scan(x, y, jax.random.key(0), ITERS)
+    losses = step.run_scan(x, y, jax.random.key(1), ITERS)
+    assert bool(jnp.isfinite(losses).all())
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    t0 = time.perf_counter()
+    step.run_scan(x, y, jax.random.key(2), ITERS)
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    wall = time.perf_counter() - t0
+    print(f"{tag}: {256*ITERS/wall:,.0f} img/s ({wall/ITERS*1e3:.1f} ms/step)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    os.environ["BIGDL_POOL_KERNEL"] = "off"
+    run("select-and-scatter")
+    # "on", not "auto": auto maps to off until this very experiment
+    # proves the kernel on hardware (pallas_pool_supported)
+    os.environ["BIGDL_POOL_KERNEL"] = "on"
+    try:
+        run("pallas-argmax-idx")
+    except Exception:
+        print("pallas-argmax-idx: FAILED", flush=True)
+        traceback.print_exc()
