@@ -8,7 +8,14 @@ TPU-native:
 
 - the int8 x int8 -> int32 contraction runs on the MXU at TWICE the
   bf16 macs/cycle on v5e (394 int8 TOPS vs 197 bf16 TFLOP/s), so
-  quantized inference is a throughput feature, not just a memory one;
+  quantized inference is a throughput feature, not just a memory one.
+  MEASURED (round 5, TPU v5e, BASELINE.md int8 table): VGG-16 inference
+  2.09x bf16 end-to-end — the 2x MXU claim holds when the model is
+  MXU-bound.  Inception-v1 is 0.62x (a LOSS): its small-channel
+  branches are fragmentation/memory-bound, and the dynamic activation
+  quantize/dequantize passes add HBM traffic the idle int8 rate cannot
+  buy back.  Guidance: quantize big-GEMM models (VGG, transformer
+  projections); keep fragmented convnets in bf16;
 - weights store as int8 buffers (4x smaller than f32 in BTPU
   checkpoints and in HBM);
 - `quantize(model)` mirrors `Module.quantize()` in the reference's API
