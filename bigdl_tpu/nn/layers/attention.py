@@ -51,8 +51,8 @@ class MultiHeadAttention(Module):
     """Multi-head attention over [batch, seq, embed] inputs.
 
     ``backend``: 'auto' (on TPU: flash when ``max(Sq, Sk)`` reaches
-    ``bigdl_tpu.ops.attention.flash_min_seq()`` — default 1024, env
-    ``BIGDL_FLASH_MIN_SEQ`` — else dense, which at short sequence is one
+    ``bigdl_tpu.ops.attention.flash_min_seq()`` — default 512, env
+    ``BIGDL_FLASH_MIN_SEQ`` — else dense, which below one k-block is one
     batched MXU matmul; always dense off-TPU), 'dense',
     'flash', or a callable ``f(q, k, v) -> out`` over [B, H, S, D] arrays
     with causal/scale baked in — e.g. a shard_map-wrapped ring/ulysses
@@ -111,12 +111,13 @@ class MultiHeadAttention(Module):
         if backend == "auto":
             from bigdl_tpu.ops.attention import flash_min_seq, is_tpu_device
 
-            # dense below the threshold: one big batched MXU matmul
-            # beats the per-head flash tiles there (round-5 profile:
-            # flash was 53% of the seq-512 transformer step); flash
-            # above it, where the Sq x Sk score tensor pressures HBM —
-            # judged on BOTH lengths so a short-query cross-attention
-            # over a long k/v still streams
+            # dense below the threshold, flash at/above it.  With the
+            # round-5 block defaults (1024/512) flash BEATS dense from
+            # seq 512 up (exp_attention_backend: 734 vs 562 seq/s — the
+            # earlier "flash was 53% of the seq-512 step" profile was an
+            # artifact of the old 128x128 blocks); judged on BOTH
+            # lengths so a short-query cross-attention over a long k/v
+            # still streams
             backend = "flash" if (is_tpu_device() and mask is None
                                   and max(q.shape[2], k.shape[2])
                                   >= flash_min_seq()) \

@@ -123,16 +123,21 @@ def _use_interpret() -> bool:
 
 def flash_min_seq() -> int:
     """Sequence length at which ``backend='auto'`` switches from dense
-    to flash attention (``BIGDL_FLASH_MIN_SEQ``, default 1024).
+    to flash attention (``BIGDL_FLASH_MIN_SEQ``, default 512).
 
-    Round-5 TPU v5e profile: at seq 512 the Pallas flash fwd+bwd pair
-    consumed 53% of the transformer_lm train step — the per-head
-    (block_q x d=64 x block_k) tiles underfill the 128x128 MXU and the
-    grid iteration cost dominates — while dense attention is one large
-    batched matmul XLA maps straight onto the MXU.  Flash's O(S) memory
-    only pays above the threshold where the S^2 score tensor starts to
-    pressure HBM (seq 4096 long-context config: 1 GB+)."""
-    raw = os.environ.get("BIGDL_FLASH_MIN_SEQ", "1024")
+    History of this threshold (both decisions measured on TPU v5e):
+    the round-5 profile first showed flash at the OLD 128x128 default
+    blocks consuming 53% of the seq-512 transformer_lm step (tiny
+    per-head tiles underfill the 128x128 MXU; grid iteration dominates),
+    so the gate was introduced at 1024.  The round-5 block sweep
+    (`exp_flash_blocks`, BASELINE.md) then fixed the block defaults to
+    1024/512 — 3.5x faster at seq 4096 — and the re-run A/B
+    (`exp_attention_backend`) showed properly-blocked flash BEATING
+    dense at seq 512 (734 vs 562 seq/s: the S^2 score tensor never
+    round-trips HBM), so the default dropped to 512.  Below 512 the
+    sequence is shorter than one k block and dense's single fused
+    matmul still wins."""
+    raw = os.environ.get("BIGDL_FLASH_MIN_SEQ", "512")
     try:
         return int(raw)
     except ValueError as e:
@@ -457,17 +462,21 @@ def flash_attention(q, k, v, causal: bool = False,
     materialization).  Off-TPU the kernels run in Pallas interpret mode so
     the identical code path is testable on the CPU mesh.
 
-    Block sizes default to 128/128; ``BIGDL_FLASH_BLOCK_Q`` /
-    ``BIGDL_FLASH_BLOCK_K`` override them process-wide so hardware block
-    sweeps (``tools/experiments/exp_flash_blocks.py``) need no code
-    change.
+    Block sizes default to 1024/512 (clamped to the sequence):
+    the round-5 hardware sweep (`tools/experiments/exp_flash_blocks.py`,
+    BASELINE.md) measured seq-4096 training 3.5x FASTER at 1024/512 than
+    at the old 128/128 default — small blocks underfill the MXU and pay
+    the grid-iteration overhead per tiny tile, exactly the short-seq
+    pathology the auto backend routes to dense.  ``BIGDL_FLASH_BLOCK_Q``
+    / ``BIGDL_FLASH_BLOCK_K`` override process-wide so sweeps need no
+    code change.
     """
     import os
 
     if block_q is None:
-        block_q = int(os.environ.get("BIGDL_FLASH_BLOCK_Q", "128"))
+        block_q = int(os.environ.get("BIGDL_FLASH_BLOCK_Q", "1024"))
     if block_k is None:
-        block_k = int(os.environ.get("BIGDL_FLASH_BLOCK_K", "128"))
+        block_k = int(os.environ.get("BIGDL_FLASH_BLOCK_K", "512"))
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if interpret is None:

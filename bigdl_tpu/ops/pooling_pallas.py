@@ -77,7 +77,7 @@ def pallas_pool_supported(x, dims, strides, pads) -> bool:
     ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, (pads[2], pads[3]))
     esz = jnp.dtype(x.dtype).itemsize
     # the single-row footprint must fit the budget even at bb=1
-    if _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz) > _VMEM_BUDGET:
+    if _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw) > _VMEM_BUDGET:
         return False  # fall back to reduce_window / select-and-scatter
     if mode == "auto":
         # OPT-IN until the Mosaic lowering is proven on hardware: the
@@ -108,14 +108,25 @@ def _geometry(h: int, w: int, kh: int, kw: int, sh: int, sw: int,
     return ho, wo, lh, lw
 
 
-def _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz) -> int:
+def _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw) -> int:
     """Upper-bound VMEM footprint per N*C row — shared by the support
-    gate and both kernel launchers so they can never drift apart.  The
-    2x padded-plane term covers the backward's residue parts + stacked
-    interleave (the forward's xb + phase copies fit under the same
-    bound)."""
-    return (h * w + 2 * (lh * sh) * (lw * sw)) * esz \
+    gate and both kernel launchers so they can never drift apart.
+
+    Calibrated against the compiler's scoped-vmem stack report on
+    hardware (round 5): the scoped stack does NOT reuse slots across the
+    unrolled tap chain (35.8 MB at block 512 on the 28x28 pool = ~23
+    co-live planes for 9 taps), so the forward budget is ~3 f32
+    full-res planes per tap (v copy + mask + idx chain) plus xb, best,
+    idx and the decimation transposes; the backward's per-shift
+    temporaries are quarter-planes in the gradient dtype, ~3 per tap,
+    plus the interleave stack at full plane size."""
+    plane = (lh * sh) * (lw * sw)
+    taps = kh * kw
+    fwd = h * w * esz + (3 * taps + 5) * plane * 4 \
         + ho * wo * (esz + 1 + 4)
+    bwd = (3 * taps // (sh * sw) + 4) * plane * esz + plane * 4 \
+        + ho * wo * (esz + 1 + 4 + 4)
+    return max(fwd, bwd)
 
 
 def _pick_block(b: int, row_bytes: int) -> int:
@@ -129,47 +140,103 @@ def _pick_block(b: int, row_bytes: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Mosaic-supported decimation / interleave primitives.
+#
+# What the backend actually lowers (learned on hardware, round 5):
+#   * strided vector slices: NO  (vector.extract_strided_slice stride=1)
+#   * splitting/merging the SUBLANE (second-minor) dim via reshape +
+#     scalar middle-axis index: YES
+#   * splitting/merging the LANE (minor) dim via reshape: NO
+#     (tpu.reshape [..,114] -> [..,57,2] rejected)
+#   * last-two-axes transpose: YES
+# So lane-axis decimation = transpose, sublane decimation, transpose.
+# ---------------------------------------------------------------------------
+
+def _decimate_rows(a, s: int, n_out: int):
+    """[bb, s*n_out, M] -> [bb, n_out, M] keeping rows 0, s, 2s, ...
+    The extent must be an exact multiple: an in-kernel pad here lowers
+    to tpu.concatenate, which rejects operands whose accumulated layout
+    offsets differ (seen on hardware: 'result/input offset mismatch on
+    non-concat dimension')."""
+    if s == 1:
+        return a[:, :n_out, :]
+    bb, r, m = a.shape
+    assert r == s * n_out, (r, s, n_out)
+    return a.reshape(bb, n_out, s, m)[:, :, 0, :]
+
+
+def _decimate_cols(a, s: int, n_out: int):
+    """[bb, R, M] -> [bb, R, n_out] keeping cols 0, s, 2s, ..."""
+    if s == 1:
+        return a[:, :, :n_out]
+    at = jnp.swapaxes(a, 1, 2)
+    return jnp.swapaxes(_decimate_rows(at, s, n_out), 1, 2)
+
+
+def _interleave_rows(parts, s: int):
+    """s arrays [bb, L, M] -> [bb, L*s, M], out[s*a + r] = parts[r][a]."""
+    if s == 1:
+        return parts[0]
+    bb, l, m = parts[0].shape
+    return jnp.stack(parts, axis=2).reshape(bb, l * s, m)
+
+
+def _interleave_cols(parts, s: int):
+    """s arrays [bb, L, M] -> [bb, L, M*s], out[.., s*b + r] = parts[r][.., b]."""
+    if s == 1:
+        return parts[0]
+    at = _interleave_rows([jnp.swapaxes(p, 1, 2) for p in parts], s)
+    return jnp.swapaxes(at, 1, 2)
+
+
+# ---------------------------------------------------------------------------
 # forward kernel: x -> (y, idx)
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(x_ref, y_ref, idx_ref, *, kh, kw, sh, sw, pads, ho, wo,
                 lh, lw):
-    x = x_ref[...]
-    (lo_h, _), (lo_w, _) = pads
-    hp, wp = lh * sh, lw * sw
-    xb = jnp.pad(x, ((0, 0), (lo_h, hp - lo_h - x.shape[1]),
-                     (lo_w, wp - lo_w - x.shape[2])),
-                 constant_values=_NEG)
+    # compute in f32: Mosaic rejects arith.cmpf on packed-bf16 native
+    # tiles (vector<8x128x2xbf16>), and the tap loop is comparison-heavy
+    x = x_ref[...].astype(jnp.float32)
+    (lo_h, hi_h), (lo_w, hi_w) = pads
     bb = x.shape[0]
-    # phase-split ONCE (Mosaic rejects strided slices — stride must be
-    # 1 in vector.extract_strided_slice — so decimation happens via
-    # reshape splits + scalar index, verified to lower): phase[rh][rw]
-    # holds padded positions (sh*a + rh, sw*b + rw)
-    phases = []
-    r4 = xb.reshape(bb, lh, sh, wp)
-    for rh in range(sh):
-        row_plane = r4[:, :, rh, :].reshape(bb, lh, lw, sw)
-        phases.append([row_plane[:, :, :, rw] for rw in range(sw)])
-
-    best = jnp.full((bb, ho, wo), _NEG, x.dtype)
-    idx = jnp.zeros((bb, ho, wo), jnp.int32)
+    # windowed max + argmax at FULL (stride-1) resolution — every tap is
+    # a stride-1 slice — then decimate rows/cols once at the end.  The
+    # full-res extent is sh*ho (an exact stride multiple, so the
+    # decimation reshape needs no pad): rows past the last valid window
+    # start are junk computed over -inf padding and dropped by the
+    # decimation
+    rh_, rw_ = sh * ho, sw * wo
+    eh = (kh - 1 + rh_) - (lo_h + x.shape[1] + hi_h)
+    ew = (kw - 1 + rw_) - (lo_w + x.shape[2] + hi_w)
+    xb = jnp.pad(x, ((0, 0), (lo_h, hi_h + max(eh, 0)),
+                     (lo_w, hi_w + max(ew, 0))),
+                 constant_values=_NEG)
+    best = jnp.full((bb, rh_, rw_), _NEG, jnp.float32)
+    idx = jnp.zeros((bb, rh_, rw_), jnp.int32)
+    # unrolled taps: a rolled fori needs dynamic_slice on values, which
+    # the Mosaic lowering does not implement.  The cost of unrolling is
+    # VMEM: the compiler's scoped stack keeps every tap's temporaries
+    # co-live (no slot reuse — measured 35.8 MB at block 512 on the
+    # 28x28 pool), so _row_bytes budgets ~3 live planes per tap and
+    # _pick_block shrinks the block accordingly.
     t = 0
     for dh in range(kh):
-        rh, jh = dh % sh, dh // sh
         for dw in range(kw):
-            rw, jw = dw % sw, dw // sw
-            # tap (dh, dw) at output (o_h, o_w) reads padded position
-            # (sh*(o_h + jh) + rh, ...): a stride-1 window of the phase
-            v = phases[rh][rw][:, jh:jh + ho, jw:jw + wo]
+            v = xb[:, dh:dh + rh_, dw:dw + rw_]
             # strict >: a later equal tap never steals -> first argmax.
             # NaN taps must still win (reduce_window propagates NaN; a
-            # silent NaN->-inf would hide a diverged run)
-            take = (v > best) | jnp.isnan(v)
-            best = jnp.where(take, v, best)
-            idx = jnp.where(take, t, idx)
+            # silent NaN->-inf would hide a diverged run).  Integer mask
+            # arithmetic + NaN-propagating maximum instead of jnp.where:
+            # Mosaic rejected the i1-mask select's relayout.
+            take = ((v > best) | jnp.isnan(v)).astype(jnp.int32)
+            idx = take * t + (1 - take) * idx
+            best = jnp.maximum(best, v)
             t += 1
-    y_ref[...] = best
-    idx_ref[...] = idx.astype(idx_ref.dtype)
+    y_ref[...] = _decimate_cols(_decimate_rows(best, sh, ho), sw, wo
+                                ).astype(y_ref.dtype)
+    idx_ref[...] = _decimate_cols(_decimate_rows(idx, sh, ho), sw, wo
+                                  ).astype(idx_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -199,7 +266,12 @@ def _bwd_kernel(gy_ref, idx_ref, dx_ref, *, kh, kw, sh, sw, pads, h, w,
                     if dw >= kw:
                         continue
                     t = dh * kw + dw
-                    g = jnp.where(idx == t, gy, jnp.zeros((), gy.dtype))
+                    # mask-multiply, not where: see the fwd kernel's
+                    # i1-relayout note.  Caveat vs select-and-scatter:
+                    # a non-finite gy element leaks NaN into the OTHER
+                    # taps' positions too (0 * inf = NaN) — wider NaN
+                    # spread on an already-diverged step, never hidden
+                    g = (idx == t).astype(gy.dtype) * gy
                     nh, nw = min(ho, lh - jh), min(wo, lw - jw)
                     g = g[:, :nh, :nw]
                     # static pad to the residue grid (Mosaic-friendlier
@@ -210,12 +282,11 @@ def _bwd_kernel(gy_ref, idx_ref, dx_ref, *, kh, kw, sh, sw, pads, h, w,
             row.append(acc)
         parts.append(row)
 
-    if sh == 1 and sw == 1:
-        dxp = parts[0][0]
-    else:
-        # interleave the residue grids: [bb, lh, sh, lw, sw] -> [bb, lh*sh, lw*sw]
-        stacked = jnp.stack([jnp.stack(row, axis=-1) for row in parts], axis=2)
-        dxp = stacked.reshape(bb, lh * sh, lw * sw)
+    # interleave the residue grids back to the padded input plane:
+    # cols per row-phase (transpose-based lane interleave), then rows
+    # (sublane interleave) — see the Mosaic support notes above
+    rows = [_interleave_cols(row, sw) for row in parts]
+    dxp = _interleave_rows(rows, sh)
     dx_ref[...] = lax.slice(dxp, (0, lo_h, lo_w),
                             (bb, lo_h + h, lo_w + w))
 
@@ -248,7 +319,7 @@ def _fwd_impl(x, dims, strides, pads):
     b = n * c
     xr = x.reshape(b, h, w)
     esz = x.dtype.itemsize
-    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz))
+    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw))
     kern = functools.partial(_fwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
                              pads=hw_pads, ho=ho, wo=wo, lh=lh, lw=lw)
     y, idx = pl.pallas_call(
@@ -278,7 +349,7 @@ def _vjp_bwd(dims, strides, pads, xshape, idx, gy):
     b = n * c
     gyr = gy.reshape(b, ho, wo)
     esz = jnp.dtype(x_dtype).itemsize
-    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz))
+    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw))
     kern = functools.partial(_bwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
                              pads=hw_pads, h=h, w=w, lh=lh, lw=lw)
     dx = pl.pallas_call(

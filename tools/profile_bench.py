@@ -88,7 +88,12 @@ def parse_xplane(logdir):
 
 
 if __name__ == "__main__":
-    cfg = sys.argv[1] if len(sys.argv) > 1 else "inception_v1_imagenet"
+    # argv wins; BENCH_CONFIGS honored as fallback because the runbook
+    # documents that form (a silent default-to-inception here once cost
+    # a round-5 profiling window)
+    env_cfg = os.environ.get("BENCH_CONFIGS", "").split(",")[0].strip()
+    cfg = sys.argv[1] if len(sys.argv) > 1 \
+        else (env_cfg or "inception_v1_imagenet")
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else None
     iters = int(sys.argv[3]) if len(sys.argv) > 3 else 8
     logdir = capture(cfg, batch, iters)
