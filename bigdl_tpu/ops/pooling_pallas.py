@@ -1,41 +1,49 @@
-"""Pallas TPU max-pool with an argmax-index backward.
+"""Argmax-index max-pool: packed-u32 XLA forward + Pallas scatter backward.
 
-Why this kernel exists (round-5 TPU profile, Inception-v1 train step):
-XLA's select-and-scatter backward — the best of the three maxpool
-gradients measured so far (BASELINE.md round-3 table) — still re-reads
-the full input activation AND the pool output to locate each window's
-first argmax: ~21.5% of the step in select_and_scatter fusions plus
-~7.1% in the compare/select index path, all of it HBM-bound traffic
-over tensors like the [256,64,112,112] first-pool activation.
+Why this exists (round-5 TPU profile, Inception-v1 train step): XLA's
+select-and-scatter backward — the best of the three maxpool gradients
+measured so far (BASELINE.md round-3 table) — re-reads the full input
+activation AND the pool output to locate each window's first argmax:
+~21.5% of the step in select_and_scatter fusions plus ~7.1% in the
+compare/select index path, all HBM-bound traffic over tensors like the
+[256,64,112,112] first-pool activation.
 
-This kernel removes the re-read.  The forward computes the max and the
-*winning tap index* (0..kh*kw-1, int8) in one pass over the input; the
-backward then scatters gy straight from (gy, idx) — it never touches x
-or y again:
+Design (settled by hardware iteration — four Mosaic lowering classes and
+one VMEM-economics dead end are documented in BASELINE.md):
+
+- **Forward: one XLA ``reduce_window`` over packed u32.**  Each element
+  packs ``monotonic(bf16 bits) << 16 | inverted low-8 (h, w) coords``;
+  integer max then yields the window max AND its position in a single
+  window pass: the monotonic map makes float order = unsigned order, the
+  inverted coordinates break value ties toward the smallest (h, w) —
+  the reference's first-argmax (``nn/NNPrimitive.scala:594-972``) — and
+  a NaN's monotonic image is the largest u16, so NaN propagates exactly
+  like ``lax.reduce_window(max)``.  The pack/unpack are elementwise and
+  fuse into the reduce; no Pallas forward and no extra VPU argmax chain
+  (a full Pallas forward measured ~2 ms of pure compare work on the
+  first pool alone — more than the backward win it enabled).
+- **Backward: a Pallas scatter kernel in channel-last layout.**
+  ``(gy, idx) -> dx`` never touches x or y.  The layout is
+  ``[rows, cols, N*C]``: rows land on the UNTILED leading dim (row
+  phase-split/interleave are free reshapes), cols on the sublane dim
+  (the one dim Mosaic reshape-splits natively), batch*channel on lanes
+  (pure SIMD).  Every slice is static; halo rows come from a
+  neighbor-block BlockSpec, not DMA code.
 
     select-and-scatter bwd traffic:  read x + read y + read gy + write dx
-    argmax-index bwd traffic:        read gy + read idx(+1/8 size) + write dx
+    argmax-index bwd traffic:        read gy + read idx(1/2 size) + write dx
 
-Both passes run as one Pallas grid over N*C row-blocks with the whole
-(H, W) plane resident in VMEM, so the residue-class interleave that made
-the pure-XLA gather backward slow (an extra HBM relayout pass) happens
-in-register instead.
-
-Semantics: first-argmax tie-breaking in lexicographic (kh, kw) tap
-order — bit-parity with the reference's CPU loop
-(``nn/NNPrimitive.scala:594-972``, rows then cols) and with XLA's
-select-and-scatter lowering, asserted in ``tests/test_pooling_pallas.py``.
-
-Off-TPU the kernel runs in Pallas interpret mode so the CPU test mesh
-exercises the identical code path.  ``BIGDL_POOL_KERNEL=off`` falls back
-to select-and-scatter (the measured round-3 default).
+Supported: 16-bit float dtypes (bf16/f16 — the bench path).  f32 would
+need a u64 pack; it falls back to select-and-scatter.  Off-TPU the
+backward runs in Pallas interpret mode so the CPU mesh exercises the
+same code path.  ``BIGDL_POOL_KERNEL=off`` forces the fallback.
 """
 
 from __future__ import annotations
 
 import functools
 import os
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,23 +56,27 @@ __all__ = ["maxpool_argmax", "pallas_pool_supported"]
 
 _NEG = float("-inf")
 
-#: unrolled taps beyond this would bloat compile time (same cap as the
-#: tie-split VJP in nn/layers/pooling.py)
+#: windows larger than this are global-pool-sized; the unrolled shift
+#: structure in the backward would bloat compile time
 _MAX_TAPS = 64
 
 #: per-block VMEM budget (bytes); conservative vs the 16 MB/core arena
 _VMEM_BUDGET = 6 * 1024 * 1024
 
+#: lane-chunk and row-tile defaults for the backward grid
+_LANES = 512
+_ROW_TILE = 8
+
 
 def pallas_pool_supported(x, dims, strides, pads) -> bool:
-    """True when (x, window) fits this kernel: 4-D NCHW input, window on
-    the trailing two axes only, float dtype, bounded tap count, and a
-    single (H, W) plane that fits the per-block VMEM budget."""
+    """True when (x, window) fits this path: 4-D NCHW input, window on
+    the trailing two axes, 16-bit float dtype, window extents within the
+    low-8-bit coordinate encoding, bounded tap count."""
     mode = os.environ.get("BIGDL_POOL_KERNEL", "auto")
     if mode == "off":
         return False
-    if x.ndim != 4 or not jnp.issubdtype(x.dtype, jnp.floating):
-        return False
+    if x.ndim != 4 or x.dtype not in (jnp.bfloat16, jnp.float16):
+        return False  # f32 would need a u64 pack
     if dims[0] != 1 or dims[1] != 1 or strides[0] != 1 or strides[1] != 1:
         return False  # pooled axes must be the trailing (H, W) pair
     if pads[0] != (0, 0) or pads[1] != (0, 0):
@@ -72,24 +84,43 @@ def pallas_pool_supported(x, dims, strides, pads) -> bool:
     kh, kw = dims[2], dims[3]
     if kh * kw > _MAX_TAPS or kh < 1 or kw < 1:
         return False
-    h, w = x.shape[2], x.shape[3]
     sh, sw = strides[2], strides[3]
-    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, (pads[2], pads[3]))
-    esz = jnp.dtype(x.dtype).itemsize
-    # the single-row footprint must fit the budget even at bb=1
-    if _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw) > _VMEM_BUDGET:
-        return False  # fall back to reduce_window / select-and-scatter
+    ho, wo, lh, lw = _geometry(x.shape[2], x.shape[3], kh, kw, sh, sw,
+                               (pads[2], pads[3]))
+    (lo_h, hi_h), (lo_w, hi_w) = pads[2], pads[3]
+    if lo_h + x.shape[2] + hi_h > 256 or lo_w + x.shape[3] + hi_w > 256:
+        # the low-8 coordinate code wraps at padded position 256, which
+        # would invert first-argmax tie order across the wrap
+        return False
+    n = x.shape[0]
+    if n * x.shape[1] % 8:
+        return False  # lane chunking wants a multiple-of-8 batch extent
+    # the backward block must fit the VMEM budget even at the minimum
+    # (th=1, bl=8) tile — otherwise fall back instead of a Mosaic
+    # VMEM-overflow compile error
+    jw_max = -(-kw // sw) - 1
+    cpad = -(-(jw_max + lw) // 8) * 8
+    if _bwd_est(1, 8, cpad, kh * kw, jnp.dtype(x.dtype).itemsize) \
+            > _VMEM_BUDGET:
+        return False
     if mode == "auto":
-        # OPT-IN until the Mosaic lowering is proven on hardware: the
-        # first on-chip compile (round 5) rejected the strided tap
-        # extraction (vector.extract_strided_slice strides must be 1),
-        # so "auto" currently means off; flip after the stride-free
-        # formulation A/Bs a win (tools/experiments/exp_pool_kernel.py).
-        # NB gate on is_tpu_device(), not jax.default_backend() ==
-        # "tpu": proxied PJRT plugins (axon) register under their own
-        # platform name — the round-4 flash-attention gating bug.
+        # OPT-IN until the scatter kernel A/Bs a win on hardware
+        # (tools/experiments/exp_pool_kernel.py).  NB is_tpu_device(),
+        # not jax.default_backend() == "tpu": proxied PJRT plugins
+        # (axon) register under their own platform name — the round-4
+        # flash-attention gating bug.
         return False
     return True  # "interpret" / "on": run everywhere (tests)
+
+
+def _bwd_est(th: int, bl: int, cpad: int, taps: int, esz: int) -> int:
+    """Scoped-VMEM stack estimate for one backward block — shared by the
+    support gate and the launcher's block chooser so they can't drift.
+    Calibrated on hardware: the Mosaic stack does not reuse slots across
+    the unrolled shift chain (~3 live planes per tap) plus the i32
+    index upcast and block inputs."""
+    plane = th * cpad * bl
+    return (3 * taps + 6) * plane * esz + 3 * plane * 4
 
 
 def _use_interpret() -> bool:
@@ -100,7 +131,7 @@ def _use_interpret() -> bool:
 
 def _geometry(h: int, w: int, kh: int, kw: int, sh: int, sw: int,
               pads: Tuple[Tuple[int, int], Tuple[int, int]]):
-    """Padded extents, residue-class lengths, output sizes."""
+    """Output sizes and residue-class lengths on the padded grid."""
     (lo_h, hi_h), (lo_w, hi_w) = pads
     ph, pw = lo_h + h + hi_h, lo_w + w + hi_w
     ho, wo = (ph - kh) // sh + 1, (pw - kw) // sw + 1
@@ -108,155 +139,105 @@ def _geometry(h: int, w: int, kh: int, kw: int, sh: int, sw: int,
     return ho, wo, lh, lw
 
 
-def _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw) -> int:
-    """Upper-bound VMEM footprint per N*C row — shared by the support
-    gate and both kernel launchers so they can never drift apart.
+# ---------------------------------------------------------------------------
+# forward: packed-u32 reduce_window (pure XLA)
+# ---------------------------------------------------------------------------
 
-    Calibrated against the compiler's scoped-vmem stack report on
-    hardware (round 5): the scoped stack does NOT reuse slots across the
-    unrolled tap chain (35.8 MB at block 512 on the 28x28 pool = ~23
-    co-live planes for 9 taps), so the forward budget is ~3 f32
-    full-res planes per tap (v copy + mask + idx chain) plus xb, best,
-    idx and the decimation transposes; the backward's per-shift
-    temporaries are quarter-planes in the gradient dtype, ~3 per tap,
-    plus the interleave stack at full plane size."""
-    plane = (lh * sh) * (lw * sw)
-    taps = kh * kw
-    fwd = h * w * esz + (3 * taps + 5) * plane * 4 \
-        + ho * wo * (esz + 1 + 4)
-    bwd = (3 * taps // (sh * sw) + 4) * plane * esz + plane * 4 \
-        + ho * wo * (esz + 1 + 4 + 4)
-    return max(fwd, bwd)
+def _monotonic_u16(x):
+    """Map 16-bit float bits to u16 such that float order == unsigned
+    order (negatives flip all bits, positives flip the sign bit).  NaN
+    maps above +inf, so integer max propagates it like float max.
+    -0.0 collapses onto +0.0's key: the floats compare EQUAL, so the
+    tie must resolve by position (select-and-scatter routes it to the
+    first element), not by sign bit."""
+    u = lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    sign = u >> 15
+    mono = (u ^ (0x8000 + sign * 0x7FFF)) & 0xFFFF
+    mono = jnp.where(u == 0x8000, jnp.uint32(0x8000), mono)
+    # ALL NaNs (either sign bit) map to the top key: the sign-flip rule
+    # alone would drop a negative NaN below -inf and silently hide a
+    # diverged run
+    return jnp.where(jnp.isnan(x), jnp.uint32(0xFFFF), mono)
 
 
-def _pick_block(b: int, row_bytes: int) -> int:
-    """Largest divisor of b keeping the block under the VMEM budget."""
-    best = 1
-    for cand in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if b % cand == 0 and cand * row_bytes <= _VMEM_BUDGET:
-            best = cand
-            break
-    return best
+def _unmonotonic(u16, dtype):
+    sign = 1 - (u16 >> 15)  # monotonic image of a negative has top bit 0
+    bits = (u16 ^ (0x8000 + sign * 0x7FFF)) & 0xFFFF
+    return lax.bitcast_convert_type(bits.astype(jnp.uint16), dtype)
+
+
+def _fwd_packed(x, dims, strides, pads):
+    """(y, idx) from ONE u32 reduce_window.  idx = dh*kw + dw in int8,
+    first-argmax tie order, computed per output window from the packed
+    low-8 coordinates of the winning element."""
+    n, c, h, w = x.shape
+    kh, kw, sh, sw = dims[2], dims[3], strides[2], strides[3]
+    (lo_h, _), (lo_w, _) = pads[2], pads[3]
+    ho, wo, _, _ = _geometry(h, w, kh, kw, sh, sw, (pads[2], pads[3]))
+
+    mono = _monotonic_u16(x)
+    # inverted low-8 coordinates of the PADDED position: integer max
+    # prefers the largest code, so inversion makes value ties resolve to
+    # the smallest (h, w) — first argmax in the reference's scan order
+    p_h = lax.broadcasted_iota(jnp.uint32, x.shape, 2) + lo_h
+    p_w = lax.broadcasted_iota(jnp.uint32, x.shape, 3) + lo_w
+    code = ((p_h & 0xFF) ^ 0xFF) << 8 | ((p_w & 0xFF) ^ 0xFF)
+    packed = mono << 16 | code
+    # -inf's pack is the minimum over real taps; init 0 stays below any
+    # real element's pack only because mono(-inf) > 0 — use the true
+    # identity: mono maps -inf to 0x0080... so init with 0 is safe for
+    # every finite/infinite input (mono >= 0, code > 0 for real taps)
+    red = lax.reduce_window(packed, jnp.uint32(0), lax.max,
+                            dims, strides, pads)
+
+    y = _unmonotonic(red >> 16, x.dtype)
+    win_h = (red >> 8) & 0xFF ^ 0xFF
+    win_w = red & 0xFF ^ 0xFF
+    o_h = lax.broadcasted_iota(jnp.uint32, red.shape, 2)
+    o_w = lax.broadcasted_iota(jnp.uint32, red.shape, 3)
+    dh = (win_h - sh * o_h) & 0xFF
+    dw = (win_w - sw * o_w) & 0xFF
+    idx = (dh * kw + dw).astype(jnp.int8)
+    return y, idx
 
 
 # ---------------------------------------------------------------------------
-# Mosaic-supported decimation / interleave primitives.
-#
-# What the backend actually lowers (learned on hardware, round 5):
-#   * strided vector slices: NO  (vector.extract_strided_slice stride=1)
-#   * splitting/merging the SUBLANE (second-minor) dim via reshape +
-#     scalar middle-axis index: YES
-#   * splitting/merging the LANE (minor) dim via reshape: NO
-#     (tpu.reshape [..,114] -> [..,57,2] rejected)
-#   * last-two-axes transpose: YES
-# So lane-axis decimation = transpose, sublane decimation, transpose.
+# backward: Pallas scatter kernel, channel-last layout
 # ---------------------------------------------------------------------------
 
-def _decimate_rows(a, s: int, n_out: int):
-    """[bb, s*n_out, M] -> [bb, n_out, M] keeping rows 0, s, 2s, ...
-    The extent must be an exact multiple: an in-kernel pad here lowers
-    to tpu.concatenate, which rejects operands whose accumulated layout
-    offsets differ (seen on hardware: 'result/input offset mismatch on
-    non-concat dimension')."""
-    if s == 1:
-        return a[:, :n_out, :]
-    bb, r, m = a.shape
-    assert r == s * n_out, (r, s, n_out)
-    return a.reshape(bb, n_out, s, m)[:, :, 0, :]
+def _bwd_kernel(gy_ref, gy_next_ref, idx_ref, idx_next_ref, dx_ref, *,
+                kh, kw, sh, sw, jh_max, jw_pad, th, w_out_cols, lo_w):
+    """One (row-tile, lane-chunk) block.
 
+    Row geometry: gy/idx arrive TOP-PADDED by jh_max rows (and tiled by
+    th), so for output-grid row a in this tile and row shift jh the
+    source row is ``a + jh_max - jh`` — always in [0, th + jh_max),
+    covered by this block plus the first jh_max rows of the next block.
+    Col geometry: gy/idx arrive LEFT-PADDED by jw_pad cols on the
+    sublane dim, so col shifts are static slices too.  All shifts
+    static, rows untiled (leading), cols sublane, lanes batch."""
+    gy = jnp.concatenate([gy_ref[...], gy_next_ref[0:jh_max]], axis=0) \
+        if jh_max else gy_ref[...]
+    idx = jnp.concatenate([idx_ref[...], idx_next_ref[0:jh_max]], axis=0) \
+        if jh_max else idx_ref[...]
+    idx = idx.astype(jnp.int32)
+    bl = gy.shape[2]
 
-def _decimate_cols(a, s: int, n_out: int):
-    """[bb, R, M] -> [bb, R, n_out] keeping cols 0, s, 2s, ..."""
-    if s == 1:
-        return a[:, :, :n_out]
-    at = jnp.swapaxes(a, 1, 2)
-    return jnp.swapaxes(_decimate_rows(at, s, n_out), 1, 2)
+    # hoist the column shifts: a sublane-offset slice is a relayout
+    # copy, so take each jw view ONCE (jw_max+1 of them) — the per-tap
+    # row shifts below slice only the untiled leading dim (free views)
+    n_jw = jw_pad + 1
+    gy_w = [gy[:, jw_pad - jw:jw_pad - jw + w_out_cols] for jw in range(n_jw)]
+    idx_w = [idx[:, jw_pad - jw:jw_pad - jw + w_out_cols]
+             for jw in range(n_jw)]
 
-
-def _interleave_rows(parts, s: int):
-    """s arrays [bb, L, M] -> [bb, L*s, M], out[s*a + r] = parts[r][a]."""
-    if s == 1:
-        return parts[0]
-    bb, l, m = parts[0].shape
-    return jnp.stack(parts, axis=2).reshape(bb, l * s, m)
-
-
-def _interleave_cols(parts, s: int):
-    """s arrays [bb, L, M] -> [bb, L, M*s], out[.., s*b + r] = parts[r][.., b]."""
-    if s == 1:
-        return parts[0]
-    at = _interleave_rows([jnp.swapaxes(p, 1, 2) for p in parts], s)
-    return jnp.swapaxes(at, 1, 2)
-
-
-# ---------------------------------------------------------------------------
-# forward kernel: x -> (y, idx)
-# ---------------------------------------------------------------------------
-
-def _fwd_kernel(x_ref, y_ref, idx_ref, *, kh, kw, sh, sw, pads, ho, wo,
-                lh, lw):
-    # compute in f32: Mosaic rejects arith.cmpf on packed-bf16 native
-    # tiles (vector<8x128x2xbf16>), and the tap loop is comparison-heavy
-    x = x_ref[...].astype(jnp.float32)
-    (lo_h, hi_h), (lo_w, hi_w) = pads
-    bb = x.shape[0]
-    # windowed max + argmax at FULL (stride-1) resolution — every tap is
-    # a stride-1 slice — then decimate rows/cols once at the end.  The
-    # full-res extent is sh*ho (an exact stride multiple, so the
-    # decimation reshape needs no pad): rows past the last valid window
-    # start are junk computed over -inf padding and dropped by the
-    # decimation
-    rh_, rw_ = sh * ho, sw * wo
-    eh = (kh - 1 + rh_) - (lo_h + x.shape[1] + hi_h)
-    ew = (kw - 1 + rw_) - (lo_w + x.shape[2] + hi_w)
-    xb = jnp.pad(x, ((0, 0), (lo_h, hi_h + max(eh, 0)),
-                     (lo_w, hi_w + max(ew, 0))),
-                 constant_values=_NEG)
-    best = jnp.full((bb, rh_, rw_), _NEG, jnp.float32)
-    idx = jnp.zeros((bb, rh_, rw_), jnp.int32)
-    # unrolled taps: a rolled fori needs dynamic_slice on values, which
-    # the Mosaic lowering does not implement.  The cost of unrolling is
-    # VMEM: the compiler's scoped stack keeps every tap's temporaries
-    # co-live (no slot reuse — measured 35.8 MB at block 512 on the
-    # 28x28 pool), so _row_bytes budgets ~3 live planes per tap and
-    # _pick_block shrinks the block accordingly.
-    t = 0
-    for dh in range(kh):
-        for dw in range(kw):
-            v = xb[:, dh:dh + rh_, dw:dw + rw_]
-            # strict >: a later equal tap never steals -> first argmax.
-            # NaN taps must still win (reduce_window propagates NaN; a
-            # silent NaN->-inf would hide a diverged run).  Integer mask
-            # arithmetic + NaN-propagating maximum instead of jnp.where:
-            # Mosaic rejected the i1-mask select's relayout.
-            take = ((v > best) | jnp.isnan(v)).astype(jnp.int32)
-            idx = take * t + (1 - take) * idx
-            best = jnp.maximum(best, v)
-            t += 1
-    y_ref[...] = _decimate_cols(_decimate_rows(best, sh, ho), sw, wo
-                                ).astype(y_ref.dtype)
-    idx_ref[...] = _decimate_cols(_decimate_rows(idx, sh, ho), sw, wo
-                                  ).astype(idx_ref.dtype)
-
-
-# ---------------------------------------------------------------------------
-# backward kernel: (gy, idx) -> dx
-# ---------------------------------------------------------------------------
-
-def _bwd_kernel(gy_ref, idx_ref, dx_ref, *, kh, kw, sh, sw, pads, h, w,
-                lh, lw):
-    gy = gy_ref[...]
-    idx = idx_ref[...].astype(jnp.int32)
-    bb, ho, wo = gy.shape
-    (lo_h, _), (lo_w, _) = pads
-
-    # residue-class accumulation entirely in VMEM: padded position
-    # p = s*a + r receives gy[a - j] from tap d = r + s*j
-    parts = []
+    # residue-class accumulation: padded input row p = sh*a + rh
+    # receives gy[a - jh] where the tap dh = rh + sh*jh won
+    rows = []
     for rh in range(sh):
-        row = []
+        cols = []
         for rw in range(sw):
-            acc = jnp.zeros((bb, lh, lw), gy.dtype)
+            acc = jnp.zeros((th, w_out_cols, bl), gy.dtype)
             for jh in range(-(-(kh - rh) // sh)):
                 dh = rh + sh * jh
                 if dh >= kh:
@@ -266,29 +247,102 @@ def _bwd_kernel(gy_ref, idx_ref, dx_ref, *, kh, kw, sh, sw, pads, h, w,
                     if dw >= kw:
                         continue
                     t = dh * kw + dw
-                    # mask-multiply, not where: see the fwd kernel's
-                    # i1-relayout note.  Caveat vs select-and-scatter:
-                    # a non-finite gy element leaks NaN into the OTHER
-                    # taps' positions too (0 * inf = NaN) — wider NaN
-                    # spread on an already-diverged step, never hidden
-                    g = (idx == t).astype(gy.dtype) * gy
-                    nh, nw = min(ho, lh - jh), min(wo, lw - jw)
-                    g = g[:, :nh, :nw]
-                    # static pad to the residue grid (Mosaic-friendlier
-                    # than an in-place strided update)
-                    g = jnp.pad(g, ((0, 0), (jh, lh - jh - nh),
-                                    (jw, lw - jw - nw)))
-                    acc = acc + g
-            row.append(acc)
-        parts.append(row)
+                    g = gy_w[jw][jh_max - jh:jh_max - jh + th]
+                    m = idx_w[jw][jh_max - jh:jh_max - jh + th]
+                    # mask-multiply, not where (Mosaic i1-select
+                    # relayout); caveat: a non-finite gy element leaks
+                    # NaN into sibling tap positions (0 * inf) — wider
+                    # NaN spread on an already-diverged step, not hidden
+                    acc = acc + (m == t).astype(g.dtype) * g
+            cols.append(acc)
+        # W-interleave on the SUBLANE dim: [th, L, bl] x sw ->
+        # [th, L*sw, bl] with out[.., sw*b + rw, ..] = cols[rw][.., b, ..]
+        if sw == 1:
+            rows.append(cols[0])
+        else:
+            rows.append(jnp.stack(cols, axis=2).reshape(
+                th, w_out_cols * sw, bl))
+    # H-interleave on the UNTILED leading dim: free reshape
+    if sh == 1:
+        dxp = rows[0]
+    else:
+        dxp = jnp.stack(rows, axis=1).reshape(th * sh, rows[0].shape[1], bl)
+    dx_ref[...] = dxp[:, lo_w:lo_w + dx_ref.shape[1], :]
 
-    # interleave the residue grids back to the padded input plane:
-    # cols per row-phase (transpose-based lane interleave), then rows
-    # (sublane interleave) — see the Mosaic support notes above
-    rows = [_interleave_cols(row, sw) for row in parts]
-    dxp = _interleave_rows(rows, sh)
-    dx_ref[...] = lax.slice(dxp, (0, lo_h, lo_w),
-                            (bb, lo_h + h, lo_w + w))
+
+def _bwd_impl(gy, idx, x_shape, x_dtype, dims, strides, pads):
+    n, c, h, w = x_shape
+    kh, kw, sh, sw = dims[2], dims[3], strides[2], strides[3]
+    hw_pads = (pads[2], pads[3])
+    (lo_h, _), (lo_w, _) = hw_pads
+    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, hw_pads)
+    b = n * c
+    jh_max = -(-kh // sh) - 1
+    jw_max = -(-kw // sw) - 1
+
+    # channel-last: [ho, wo, b] with b = (c, n), n MINOR.  XLA's TPU
+    # layout for NCHW conv activations is {0,1,3,2} — memory order
+    # H, W, C, N — so this exact transpose is a bitcast, not a data
+    # movement; b built as (n, c) instead would force a real HBM
+    # relayout at the pallas row-major operand boundary (measured:
+    # the first A/B ran 2.2x SLOWER from exactly that).
+    gyt = jnp.transpose(gy.astype(x_dtype).reshape(n, c, ho, wo),
+                        (2, 3, 1, 0)).reshape(ho, wo, b)
+    idxt = jnp.transpose(idx.reshape(n, c, ho, wo),
+                         (2, 3, 1, 0)).reshape(ho, wo, b)
+
+    # block chooser: the Mosaic scoped stack does not reuse slots
+    # across the unrolled shift chain (measured 28.2 MB at th=8/bl=512
+    # on the first pool), so budget ~3 live planes per tap plus the i32
+    # index upcast and the block inputs, and shrink (th, bl) to fit
+    taps = kh * kw
+    cpad = -(-(jw_max + lw) // 8) * 8  # sublane-padded col extent
+    esz = jnp.dtype(x_dtype).itemsize
+
+    th, bl = _ROW_TILE, _LANES
+    while b % bl:
+        bl //= 2
+    while _bwd_est(th, bl, cpad, taps, esz) > _VMEM_BUDGET and bl > 8 \
+            and b % (bl // 2) == 0:
+        bl //= 2
+    while _bwd_est(th, bl, cpad, taps, esz) > _VMEM_BUDGET and th > 1:
+        th //= 2
+
+    # row tiling: pad top by jh_max (shift halo) + bottom to a tile
+    # multiple + one extra tile so the neighbor-block spec never reads
+    # out of range; col padding: left jw_max, right to the residue grid
+    n_tiles = -(-lh // th)
+    top, bot = jh_max, n_tiles * th - lh + th
+    right = lw - wo
+    gyp = jnp.pad(gyt, ((top, bot), (jw_max, right), (0, 0)))
+    idxp = jnp.pad(idxt, ((top, bot), (jw_max, right), (0, 0)),
+                   constant_values=-1)
+    w_cols = lw  # output-grid cols available per row after left pad
+    kern = functools.partial(
+        _bwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw, jh_max=jh_max,
+        jw_pad=jw_max, th=th, w_out_cols=w_cols, lo_w=lo_w)
+    cols_pad = gyp.shape[1]
+    dxp = pl.pallas_call(
+        kern,
+        grid=(n_tiles, b // bl),
+        in_specs=[
+            pl.BlockSpec((th, cols_pad, bl), lambda i, l: (i, 0, l)),
+            pl.BlockSpec((th, cols_pad, bl), lambda i, l: (i + 1, 0, l)),
+            pl.BlockSpec((th, cols_pad, bl), lambda i, l: (i, 0, l)),
+            pl.BlockSpec((th, cols_pad, bl), lambda i, l: (i + 1, 0, l)),
+        ],
+        out_specs=pl.BlockSpec((th * sh, lw * sw - lo_w, bl),
+                               lambda i, l: (i, 0, l)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_tiles * th * sh, lw * sw - lo_w, b), x_dtype),
+        interpret=_use_interpret(),
+    )(gyp, gyp, idxp, idxp)
+    # valid region: padded rows [lo_h, lo_h + h), cols already start at
+    # lo_w in-kernel; back to NCHW — the row-major [h, w, c, n] result
+    # transposed to NCHW is exactly the {0,1,3,2} physical layout the
+    # conv-backward consumer wants, so this folds too
+    dx = dxp[lo_h:lo_h + h, :w, :].reshape(h, w, c, n)
+    return jnp.transpose(dx, (3, 2, 0, 1))
 
 
 # ---------------------------------------------------------------------------
@@ -297,71 +351,27 @@ def _bwd_kernel(gy_ref, idx_ref, dx_ref, *, kh, kw, sh, sw, pads, h, w,
 
 def maxpool_argmax(x, dims, strides, pads):
     """Max pooling over the trailing (H, W) axes of an NCHW tensor with
-    first-argmax gradient routing via a saved int8 tap index.  Drop-in
-    for ``lax.reduce_window(max)`` under the support predicate
-    ``pallas_pool_supported``."""
+    first-argmax gradient routing via a saved int8 tap index.  Value-
+    and tie-parity with ``lax.reduce_window(max)`` + select-and-scatter
+    under the support predicate ``pallas_pool_supported``."""
     return _pool(x, dims, strides, tuple(pads), x.shape)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def _pool(x, dims, strides, pads, xshape):
     # undifferentiated primal (inference/eval): plain reduce_window —
-    # identical values, fully XLA-fusable, no wasted idx write.  The
-    # Pallas (y, idx) forward runs only under differentiation (_vjp_fwd).
+    # identical values, fully XLA-fusable, no index computation
     return lax.reduce_window(x, _NEG, lax.max, dims, strides, pads)
 
 
-def _fwd_impl(x, dims, strides, pads):
-    n, c, h, w = x.shape
-    kh, kw, sh, sw = dims[2], dims[3], strides[2], strides[3]
-    hw_pads = (pads[2], pads[3])
-    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, hw_pads)
-    b = n * c
-    xr = x.reshape(b, h, w)
-    esz = x.dtype.itemsize
-    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw))
-    kern = functools.partial(_fwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
-                             pads=hw_pads, ho=ho, wo=wo, lh=lh, lw=lw)
-    y, idx = pl.pallas_call(
-        kern,
-        grid=(b // bb,),
-        in_specs=[pl.BlockSpec((bb, h, w), lambda i: (i, 0, 0))],
-        out_specs=[pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0)),
-                   pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((b, ho, wo), x.dtype),
-                   jax.ShapeDtypeStruct((b, ho, wo), jnp.int8)],
-        interpret=_use_interpret(),
-    )(xr)
-    return y.reshape(n, c, ho, wo), idx
-
-
 def _vjp_fwd(x, dims, strides, pads, xshape):
-    y, idx = _fwd_impl(x, dims, strides, pads)
+    y, idx = _fwd_packed(x, dims, strides, pads)
     return y, idx
 
 
 def _vjp_bwd(dims, strides, pads, xshape, idx, gy):
-    n, c, h, w = xshape
-    x_dtype = gy.dtype
-    kh, kw, sh, sw = dims[2], dims[3], strides[2], strides[3]
-    hw_pads = (pads[2], pads[3])
-    ho, wo, lh, lw = _geometry(h, w, kh, kw, sh, sw, hw_pads)
-    b = n * c
-    gyr = gy.reshape(b, ho, wo)
-    esz = jnp.dtype(x_dtype).itemsize
-    bb = _pick_block(b, _row_bytes(h, w, ho, wo, lh, lw, sh, sw, esz, kh, kw))
-    kern = functools.partial(_bwd_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
-                             pads=hw_pads, h=h, w=w, lh=lh, lw=lw)
-    dx = pl.pallas_call(
-        kern,
-        grid=(b // bb,),
-        in_specs=[pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0)),
-                  pl.BlockSpec((bb, ho, wo), lambda i: (i, 0, 0))],
-        out_specs=pl.BlockSpec((bb, h, w), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, w), x_dtype),
-        interpret=_use_interpret(),
-    )(gyr, idx)
-    return (dx.reshape(n, c, h, w),)
+    dx = _bwd_impl(gy, idx, xshape, gy.dtype, dims, strides, pads)
+    return (dx,)
 
 
 _pool.defvjp(_vjp_fwd, _vjp_bwd)

@@ -67,37 +67,16 @@ print('OK', devs)
     # inside "configs" must not fail an otherwise good assembly)
     if [ "$banked" -ge 1 ] && python -c "import json,sys; d=json.load(open('$REPO/BENCH_watch.json')); sys.exit(1 if 'error' in d else 0)" 2>>"$LOG"; then
       echo "$(date -u +%H:%M:%S) banked sweep assembled -> BENCH_watch.json" >> "$LOG"
-      # harvest the REST of the runbook (docs/tpu_runbook.md) while the
-      # chip answers: profiles, real-data ingest, A/B experiments, TTA.
-      # Each leg bounded + logged; failures don't stop later legs.
-      OUT="$REPO/bench_watch"
-      mkdir -p "$OUT"
-      leg() {
-        name=$1; secs=$2; shift 2
-        # refresh the harvest sentinel: bench.py's long-wait mode keys
-        # on its mtime being FRESH, and the whole harvest can run ~4h40
-        touch /tmp/TPU_BACK
-        echo "$(date -u +%H:%M:%S) leg $name start" >> "$LOG"
-        # -k: a leg wedged in an uninterruptible device call ignores
-        # TERM; KILL escalation keeps the harvest moving
-        timeout -k 30 "$secs" "$@" > "$OUT/$name.log" 2>&1
-        rc=$?  # BEFORE the $(date) below — command substitution resets $?
-        echo "$(date -u +%H:%M:%S) leg $name rc=$rc" >> "$LOG"
-      }
-      leg inception_profile 1200 python tools/profile_bench.py inception_v1_imagenet
-      leg resnet_profile    1200 python tools/profile_bench.py resnet50_imagenet
-      leg transformer_profile 1200 python tools/profile_bench.py transformer_lm
-      leg lstm_profile      1200 python tools/profile_bench.py lstm_text_large
-      leg batch_sweep       1800 python tools/batch_sweep.py
-      leg realdata          1200 python tools/realdata_bench.py --config inception --iters 16
-      leg exp_fused         1200 python tools/experiments/exp_fused.py
-      leg exp_pool          1200 python tools/experiments/exp_pool_separable.py
-      leg exp_layout        1200 python tools/experiments/exp_layout.py
-      leg exp_flash         1200 python tools/experiments/exp_flash_blocks.py
-      leg exp_remat         1800 python tools/experiments/exp_remat.py
-      leg tta_lenet         1200 python tools/tta_bench.py --model lenet --target 0.95
-      echo "$(date -u +%H:%M:%S) runbook harvest complete -> bench_watch/" >> "$LOG"
-      exit 0
+      # The full runbook harvest (profiles, realdata, A/B experiments,
+      # TTA) completed earlier in round 5 (bench_watch/*.log, verdicts
+      # in BASELINE.md) — on later contacts the watcher only refreshes
+      # the per-config sweep so the banked artifact tracks current
+      # HEAD, then resumes probing (set TPU_WATCH_ONCE=1 to exit after
+      # the first refreshed sweep instead).
+      echo "$(date -u +%H:%M:%S) sweep refreshed (harvest legs already done)" >> "$LOG"
+      [ -n "${TPU_WATCH_ONCE:-}" ] && exit 0
+      sleep 600
+      continue  # success: skip the FAILED log line below
     fi
     echo "$(date -u +%H:%M:%S) bench sweep FAILED (see BENCH_watch.json); resuming probes" >> "$LOG"
   fi
