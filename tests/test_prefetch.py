@@ -106,12 +106,20 @@ def test_prefetch_hides_slow_input():
         waits = [w for w in o.metrics._scalars["data time"]][1:]
         return sum(waits) / len(waits)
 
-    sync_wait = run(0)
-    prefetch_wait = run(2)
+    # wall-clock assertion -> retry under load: a busy machine (parallel
+    # suites, bench sweeps) can deschedule the prefetch worker and blow
+    # the ratio; the property holds whenever ONE attempt gets fair CPU
+    last = None
+    for _ in range(3):
+        sync_wait = run(0)
+        prefetch_wait = run(2)
+        last = (prefetch_wait, sync_wait)
+        if sync_wait > 0.8 * delay and prefetch_wait < 0.5 * sync_wait:
+            return
     # sync pays the full delay per iteration; overlapped wait must drop
     # by well over half (generous margins for CI noise)
-    assert sync_wait > 0.8 * delay, sync_wait
-    assert prefetch_wait < 0.5 * sync_wait, (prefetch_wait, sync_wait)
+    assert last[1] > 0.8 * delay, last
+    assert last[0] < 0.5 * last[1], last
 
 
 def test_prefetch_surfaces_producer_errors():
