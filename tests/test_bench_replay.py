@@ -68,3 +68,23 @@ def test_corrupt_banked_artifact_still_emits_one_json_line(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["metric"] == "backend_init_failed"
     assert "replay_unavailable" in line
+
+
+def test_flash_attn_flop_correction(monkeypatch):
+    """The dense-equivalent attention FLOPs (12*L*B*H*S^2*D) are added
+    only when the auto backend would route the config to flash — off-TPU
+    (dense) the correction must be zero so MFU accounting matches what
+    XLA already counted."""
+    import bench
+    from bigdl_tpu.ops import attention
+
+    assert bench._flash_attn_flops("transformer_lm", 32) == 0.0  # cpu
+
+    monkeypatch.setattr(attention, "is_tpu_device", lambda: True)
+    got = bench._flash_attn_flops("transformer_lm", 32)
+    assert got == 12.0 * 6 * 32 * 8 * 512 * 512 * 64
+    # below the flash threshold: dense path, already counted
+    monkeypatch.setenv("BIGDL_FLASH_MIN_SEQ", "1024")
+    assert bench._flash_attn_flops("transformer_lm", 32) == 0.0
+    # non-transformer configs have no correction
+    assert bench._flash_attn_flops("inception_v1_imagenet", 256) == 0.0
