@@ -245,3 +245,45 @@ def test_fused_qkv_matches_separate_projections():
     for proj in (mha.q_proj, mha.k_proj, mha.v_proj):
         g = np.asarray(proj._grads["weight"])
         assert np.abs(g).max() > 0, "fused path left a projection gradient-free"
+
+
+def test_auto_backend_threshold_routing(monkeypatch):
+    """backend='auto' must route by max(Sq, Sk) against flash_min_seq
+    (default 512 after the round-5 block-size sweep flipped the
+    decision) — and always dense off-TPU."""
+    import bigdl_tpu.ops as O
+    import bigdl_tpu.ops.attention as A
+
+    calls = []
+    real_dense = A.dot_product_attention
+
+    def spy_flash(q, k, v, **kw):
+        calls.append("flash")
+        return real_dense(q, k, v, causal=kw.get("causal", False),
+                          scale=kw.get("scale"))
+
+    def spy_dense(q, k, v, **kw):
+        calls.append("dense")
+        return real_dense(q, k, v, **kw)
+
+    # the layer lazily does `from bigdl_tpu.ops import ...` for the
+    # kernels and `from bigdl_tpu.ops.attention import ...` for the
+    # gate — patch both namespaces
+    monkeypatch.setattr(O, "flash_attention", spy_flash)
+    monkeypatch.setattr(O, "dot_product_attention", spy_dense)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def run(seq, tpu):
+        calls.clear()
+        monkeypatch.setattr(A, "is_tpu_device", lambda: tpu)
+        import bigdl_tpu.nn as nn
+        mha = nn.MultiHeadAttention(16, 2, causal=True, backend="auto")
+        x = jnp.asarray(rng.normal(size=(1, seq, 16)).astype(np.float32))
+        mha.forward(x)
+        return calls[-1] if calls else "dense"
+
+    assert run(512, tpu=True) == "flash"    # at the threshold: flash
+    assert run(256, tpu=True) == "dense"    # below: dense (no spy call)
+    assert run(512, tpu=False) == "dense"   # off-TPU: always dense
