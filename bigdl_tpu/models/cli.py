@@ -309,6 +309,13 @@ def cmd_perf(args) -> None:
 
 
 def main(argv=None) -> None:
+    # BEFORE any jax touch: a user-pinned JAX_PLATFORMS=cpu must win
+    # over an externally-registered PJRT plugin (the axon sitecustomize
+    # overrides the env var) — without this, a CPU-pinned CLI run dials
+    # the device tunnel and can hang on a wedged link
+    from bigdl_tpu.utils.engine import honor_platform_request
+
+    honor_platform_request()
     p = argparse.ArgumentParser(prog="bigdl_tpu.models.cli",
                                 description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="cmd", required=True)
