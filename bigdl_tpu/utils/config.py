@@ -30,6 +30,19 @@ test (or an embedding application) can inject overrides with
 | log_thirdparty         | BIGDL_LOG_THIRDPARTY        | redirect third-party logs to file |
 | prefetch_batches       | BIGDL_PREFETCH              | Optimizer input double-buffering depth (0 = sync) |
 | async_checkpoint       | BIGDL_ASYNC_CHECKPOINT      | overlap checkpoint IO with training (default on) |
+
+Performance knobs read directly at their consumer (hardware-tuning
+surface, not part of the typed object because they are read at trace
+time inside jitted-program construction):
+
+| env var               | consumer |
+|-----------------------|----------|
+| BIGDL_FLASH_BLOCK_Q/K | ops.attention flash block sizes (default 1024/512 — round-5 hardware sweep) |
+| BIGDL_FLASH_MIN_SEQ   | ops.attention auto-backend threshold (default 512; dense below) |
+| BIGDL_POOL_KERNEL     | ops.pooling_pallas argmax-index pool (off/auto/on/interpret; auto=off — see BASELINE.md postmortem) |
+| BIGDL_COMPILE_CACHE   | Engine.enable_compile_cache persistent XLA executable cache dir |
+| BIGDL_SINGLETON_WAIT  | Engine.check_singleton bounded wait (s) for a lock holder |
+| JAX_PLATFORMS         | honored over externally-registered PJRT plugins via honor_platform_request |
 """
 
 from __future__ import annotations
