@@ -308,7 +308,20 @@ class Recurrent(Container):
             self.add(cell)
         self._last_state = None
         self._init_state_override = None
+        self._remat_cell = False
         self._trace_attrs = ("_last_state",)
+
+    def remat_cell(self):
+        """Recompute the cell body in the backward pass instead of
+        saving its intermediates.  The round-5 TPU profile of the large
+        LSTM config put ~21% of the step in residual stacking (the
+        [T, B, 4H] gate pre-activation buffer's init broadcast +
+        dynamic-update-slice writes); rematerialization trades that HBM
+        traffic for one extra fused-gate matmul per step in the
+        backward.  Opt-in — measure per shape
+        (``tools/experiments/exp_lstm_remat.py``)."""
+        self._remat_cell = True
+        return self
 
     @property
     def cell(self) -> Cell:
@@ -350,6 +363,8 @@ class Recurrent(Container):
             out_t, new_state = cell.step(x_t, state)
             return new_state, out_t
 
+        if self._remat_cell:
+            body = jax.checkpoint(body)
         final_state, outs = lax.scan(body, state0, xs)
         self._last_state = final_state
         return jnp.moveaxis(outs, 0, 1)

@@ -1,0 +1,55 @@
+"""A/B: Recurrent.remat_cell() on the large-LSTM bench config.
+
+The round-5 TPU profile of lstm_text_large put ~21% of the step in
+residual stacking (gate pre-activation buffer init broadcast 11.8% +
+dynamic-update-slice writes 9.3%); rematerializing the cell trades that
+HBM traffic for one extra fused-gate matmul per scan step in the
+backward (~+33% of the matmul share).  Whether that nets out positive
+is shape-dependent — measure, record the verdict in BASELINE.md, and
+flip the bench config only if remat wins.
+"""
+import sys, time
+sys.path.insert(0, '/root/repo')
+import jax, jax.numpy as jnp, numpy as np
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu import models
+from bigdl_tpu.nn.layers.rnn import Recurrent
+from bigdl_tpu.parallel.train_step import TrainStep
+from bigdl_tpu.utils.rng import RNG
+
+ITERS, BATCH = 16, 512
+rng = np.random.default_rng(0)
+
+
+def run(tag, remat):
+    RNG.set_seed(0)
+    model = models.build_lstm_classifier(
+        20000, embed_dim=512, hidden_size=1024, num_layers=2, class_num=20)
+    if remat:
+        n = 0
+        for m in model.modules():
+            if isinstance(m, Recurrent):
+                m.remat_cell()
+                n += 1
+        assert n, "no Recurrent layers found to remat"
+    step = TrainStep(model, nn.ClassNLLCriterion(),
+                     optim.SGD(learning_rate=0.01, momentum=0.9),
+                     compute_dtype=jnp.bfloat16)
+    x = jnp.asarray(rng.integers(0, 20000, (BATCH, 200), dtype=np.int32))
+    y = jnp.asarray(rng.integers(0, 20, BATCH))
+    step.aot_scan(x, y, jax.random.key(0), ITERS)
+    losses = step.run_scan(x, y, jax.random.key(1), ITERS)
+    assert bool(jnp.isfinite(losses).all())
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    t0 = time.perf_counter()
+    step.run_scan(x, y, jax.random.key(2), ITERS)
+    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    wall = time.perf_counter() - t0
+    print(f"{tag}: {BATCH*ITERS/wall:,.0f} rec/s ({wall/ITERS*1e3:.1f} ms/step)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    run("saved-gates", False)
+    run("remat-cell", True)
