@@ -1,0 +1,57 @@
+"""``bigdl_tpu.analysis`` — static graph checker and tracer-leak linter.
+
+Four passes over one shared diagnostics core (:class:`Diagnostic`
+records with severity, rule id, module path, fix hint):
+
+1. shape/dtype inference (``shape_pass``) — per-layer output specs via
+   ``jax.eval_shape``; shape mismatches, f64 promotion, dead DAG nodes;
+2. sharding validation (``sharding_pass``) — PartitionSpecs vs. the
+   actual mesh axes;
+3. retrace detection (``retrace``) — which argument caused each
+   TrainStep/EvalStep recompile;
+4. tracer-leak AST lint (``ast_lint``) — Python branches on tracers,
+   ``np.*`` on tracers, host calls inside jitted regions.
+
+CLI: ``python -m bigdl_tpu.analysis <model-name|all|path...>``.
+Library: :func:`check_model`, :func:`lint_sources`,
+:func:`trace_retraces`, :func:`check_partition_specs`.
+
+This ``__init__`` stays import-light (PEP 562 lazy attributes): the
+dispatch hook points in ``parallel/train_step.py`` import
+``analysis.hooks`` on every process, and must not drag the whole
+analyzer (or jax tracing helpers) in with them.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.analysis.diagnostics import (  # noqa: F401 - re-export
+    RULES, Diagnostic, Report, Severity, rule_severity,
+)
+
+__all__ = [
+    "Diagnostic", "Report", "Severity", "RULES", "rule_severity",
+    "check_model", "lint_sources", "lint_source", "check_shapes",
+    "output_spec", "infer_input_spec", "check_partition_specs",
+    "check_train_step", "trace_retraces", "ModelCheckResult",
+]
+
+_LAZY = {
+    "check_model": "bigdl_tpu.analysis.api",
+    "ModelCheckResult": "bigdl_tpu.analysis.api",
+    "lint_sources": "bigdl_tpu.analysis.api",
+    "lint_source": "bigdl_tpu.analysis.ast_lint",
+    "check_shapes": "bigdl_tpu.analysis.shape_pass",
+    "output_spec": "bigdl_tpu.analysis.shape_pass",
+    "infer_input_spec": "bigdl_tpu.analysis.shape_pass",
+    "check_partition_specs": "bigdl_tpu.analysis.sharding_pass",
+    "check_train_step": "bigdl_tpu.analysis.sharding_pass",
+    "trace_retraces": "bigdl_tpu.analysis.retrace",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,49 @@
+"""High-level analyzer entry points: ``check_model`` / ``lint_sources``.
+
+Library twins of the ``python -m bigdl_tpu.analysis`` CLI — run the
+static passes over a built model (or sources) and get one combined
+:class:`~bigdl_tpu.analysis.diagnostics.Report` back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, NamedTuple, Optional, Sequence
+
+from bigdl_tpu.analysis.ast_lint import lint_paths
+from bigdl_tpu.analysis.diagnostics import Report
+from bigdl_tpu.analysis.shape_pass import LayerSpec, check_shapes
+from bigdl_tpu.analysis.sharding_pass import check_train_step
+
+__all__ = ["ModelCheckResult", "check_model", "lint_sources"]
+
+
+class ModelCheckResult(NamedTuple):
+    report: Report
+    layers: List[LayerSpec]
+    out: Any  # whole-model output spec, or None when the shape walk failed
+
+    @property
+    def ok(self) -> bool:
+        return not self.report.errors
+
+
+def check_model(model, input_spec, step=None,
+                suppress: Iterable[str] = ()) -> ModelCheckResult:
+    """Run the static passes over a built model *without executing it*.
+
+    ``input_spec``: (pytree of) ``jax.ShapeDtypeStruct`` or example
+    arrays — see ``models/registry.py`` for the zoo's canonical specs.
+    ``step``: optionally a ``TrainStep`` whose parameter shardings are
+    validated against its mesh (pass 2).
+    """
+    shape_res = check_shapes(model, input_spec, suppress=suppress)
+    report = shape_res.report
+    if step is not None:
+        report.extend(check_train_step(step, suppress=suppress))
+    return ModelCheckResult(report, shape_res.layers, shape_res.out)
+
+
+def lint_sources(paths: Sequence[str],
+                 suppress: Iterable[str] = ()) -> Report:
+    """Tracer-leak AST lint (pass 4) over files/directories."""
+    return lint_paths(paths, suppress=suppress)

@@ -1,0 +1,456 @@
+"""Pass 4 — tracer-leak AST lint over Python sources.
+
+Finds the classic JAX footguns *statically*, before a trace ever runs:
+
+- ``lint/tracer-branch`` — Python ``if``/``while``/``assert`` (or
+  ``int()``/``float()``/``bool()`` concretization) on a traced value
+  inside a jitted region;
+- ``lint/tracer-numpy``  — ``np.*`` host calls consuming traced values
+  inside a jitted region;
+- ``lint/host-call``     — ``time.*`` / ``random.*`` / ``np.random.*``
+  inside a jitted region (baked in as trace-time constants).
+
+"Jitted region" is resolved lexically: a function decorated with
+``jax.jit``-family decorators, or a local ``def``/``lambda`` passed to a
+JAX transform (``jit``, ``grad``, ``vjp``, ``vmap``, ``eval_shape``,
+``checkpoint``, ``lax.scan/while_loop/cond/fori_loop/switch``, ...).
+Nested functions inherit region status and the enclosing taint set.
+Inside a region, the function's parameters are *tainted* (they are
+tracers); taint propagates through assignments — but NOT through the
+static accessors (``.shape``/``.ndim``/``.dtype``/``len()``/
+``isinstance()``/``x is None``), which is what keeps the usual
+``if x.ndim == 3`` idiom clean.
+
+Suppress a finding with a ``# noqa: <rule-id>`` comment on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.diagnostics import Report
+
+__all__ = ["lint_source", "lint_paths", "DEFAULT_LINT_DIRS"]
+
+DEFAULT_LINT_DIRS = ("bigdl_tpu", "tools", "examples")
+
+#: decorator / call targets that make the wrapped function traced code
+_TRANSFORMS = {
+    "jit", "pjit", "grad", "value_and_grad", "vjp", "jvp", "linearize",
+    "vmap", "pmap", "eval_shape", "make_jaxpr", "checkpoint", "remat",
+    "scan", "while_loop", "cond", "fori_loop", "switch",
+    "associative_scan", "custom_vjp", "custom_jvp", "shard_map",
+}
+_TRANSFORM_ROOTS = {"jax", "lax"}
+
+#: attribute reads on a tracer that yield static (host) values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "aval",
+                 "itemsize", "nbytes"}
+#: builtins that stay host-side regardless of argument
+_STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
+                 "callable", "issubclass"}
+#: builtins that force a tracer to a concrete host value (leak)
+_CONCRETIZING = {"int", "float", "bool", "complex"}
+#: np.* functions that only touch static metadata
+_NP_STATIC = {"shape", "ndim", "size", "result_type", "issubdtype",
+              "promote_types", "dtype", "isscalar"}
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<rules>[\w/,\s-]+))?", re.I)
+
+
+def _collect_noqa(src: str) -> Dict[int, Optional[Set[str]]]:
+    """line no -> None (blanket noqa) or set of rule ids."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        rules = m.group("rules")
+        out[i] = None if rules is None else \
+            {r.strip() for r in rules.split(",") if r.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_transform(func: ast.AST) -> bool:
+    dotted = _dotted(func)
+    if dotted is None:
+        return False
+    parts = dotted.split(".")
+    return parts[-1] in _TRANSFORMS and \
+        (len(parts) == 1 or parts[0] in _TRANSFORM_ROOTS)
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(...) / @jax.checkpoint(...)
+        dotted = _dotted(dec.func)
+        if dotted in ("functools.partial", "partial") and dec.args:
+            return _is_transform(dec.args[0])
+        return _is_transform(dec.func)
+    return _is_transform(dec)
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+#: ast.TryStar (except*) only exists on Python >= 3.11
+_TRY_NODES = (ast.Try,) + ((ast.TryStar,) if hasattr(ast, "TryStar")
+                           else ())
+
+
+def _find_regions(tree: ast.AST) -> Set[ast.AST]:
+    """All function/lambda nodes that are traced-code regions."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def scope_of(node: ast.AST) -> Optional[ast.AST]:
+        """Nearest enclosing function/lambda, or None for module level."""
+        p = parents.get(node)
+        while p is not None and not isinstance(p, _FUNC_NODES):
+            p = parents.get(p)
+        return p
+
+    defs_by_scope: Dict[Tuple[str, Optional[ast.AST]], List[ast.AST]] = {}
+    regions: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_scope.setdefault((node.name, scope_of(node)),
+                                     []).append(node)
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                regions.add(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_transform(node.func):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                regions.add(arg)
+            elif isinstance(arg, ast.Name):
+                # resolve like Python does: innermost enclosing scope that
+                # defines the name wins — a module-level host helper must
+                # NOT become a region because a local def shares its name
+                scope: Optional[ast.AST] = scope_of(node)
+                while True:
+                    found = defs_by_scope.get((arg.id, scope))
+                    if found:
+                        regions.update(found)
+                        break
+                    if scope is None:
+                        break
+                    scope = scope_of(scope)
+    return regions
+
+
+class _RegionLinter:
+    """Taint-tracking scan of one traced-code region."""
+
+    def __init__(self, report: Report, filename: str,
+                 noqa: Dict[int, Optional[Set[str]]]):
+        self.report = report
+        self.filename = filename
+        self.noqa = noqa
+
+    # -- reporting ---------------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              hint: str = "") -> None:
+        line = getattr(node, "lineno", 0)
+        if line in self.noqa:
+            rules = self.noqa[line]
+            if rules is None or rule in rules:
+                return
+        self.report.add(rule, message,
+                        where=f"{self.filename}:{line}", hint=hint)
+
+    # -- traced-value analysis --------------------------------------------
+    def _traced(self, node: ast.AST, tainted: Set[str]) -> bool:
+        """Does this expression yield a traced value?"""
+        t = self._traced
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return t(node.value, tainted)
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            if fname in _STATIC_CALLS or fname in _CONCRETIZING:
+                return False  # host value (concretization flagged elsewhere)
+            args_traced = any(t(a, tainted) for a in node.args) or \
+                any(t(kw.value, tainted) for kw in node.keywords)
+            func_traced = isinstance(node.func, ast.Attribute) and \
+                t(node.func.value, tainted)
+            return args_traced or func_traced
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity checks are host-safe
+            return t(node.left, tainted) or \
+                any(t(c, tainted) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(t(v, tainted) for v in node.values)
+        if isinstance(node, (ast.BinOp,)):
+            return t(node.left, tainted) or t(node.right, tainted)
+        if isinstance(node, ast.UnaryOp):
+            return t(node.operand, tainted)
+        if isinstance(node, ast.Subscript):
+            return t(node.value, tainted) or t(node.slice, tainted)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(t(e, tainted) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(t(v, tainted) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return t(node.value, tainted)
+        if isinstance(node, ast.IfExp):
+            return t(node.body, tainted) or t(node.orelse, tainted)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            inner = set(tainted)
+            for gen in node.generators:
+                if t(gen.iter, inner):
+                    self._taint_target(gen.target, inner)
+            return t(node.elt, inner)
+        if isinstance(node, ast.DictComp):
+            inner = set(tainted)
+            for gen in node.generators:
+                if t(gen.iter, inner):
+                    self._taint_target(gen.target, inner)
+            return t(node.key, inner) or t(node.value, inner)
+        if isinstance(node, ast.NamedExpr):
+            return t(node.value, tainted)
+        if isinstance(node, ast.Slice):
+            return any(t(x, tainted) for x in
+                       (node.lower, node.upper, node.step) if x is not None)
+        return False
+
+    @staticmethod
+    def _taint_target(target: ast.AST, tainted: Set[str]) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                tainted.add(n.id)
+
+    # -- per-expression rule checks ---------------------------------------
+    def _check_calls(self, expr: ast.AST, tainted: Set[str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, _FUNC_NODES):
+                continue  # nested functions handled by region recursion
+            if isinstance(node, ast.IfExp) and \
+                    self._traced(node.test, tainted):
+                self._emit("lint/tracer-branch", node,
+                           "conditional expression selects on a traced "
+                           "value inside a jitted region",
+                           hint="use jnp.where / lax.select")
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if fname is None:
+                continue
+            parts = fname.split(".")
+            args_traced = any(self._traced(a, tainted) for a in node.args) \
+                or any(self._traced(kw.value, tainted)
+                       for kw in node.keywords)
+            if parts[0] in ("time", "datetime") or \
+                    parts[0] == "random" and len(parts) > 1 or \
+                    (parts[0] in ("np", "numpy") and len(parts) > 2
+                     and parts[1] == "random"):
+                self._emit("lint/host-call", node,
+                           f"host call {fname}() inside a jitted region "
+                           f"executes once at trace time and is baked in "
+                           f"as a constant",
+                           hint="hoist it out of the traced function; for "
+                                "randomness thread a jax.random key")
+            elif parts[0] in ("np", "numpy") and \
+                    parts[-1] not in _NP_STATIC and args_traced:
+                self._emit("lint/tracer-numpy", node,
+                           f"{fname}() consumes a traced value inside a "
+                           f"jitted region — numpy cannot operate on "
+                           f"tracers",
+                           hint="use the jnp equivalent")
+            elif fname in _CONCRETIZING and args_traced:
+                self._emit("lint/tracer-branch", node,
+                           f"{fname}() concretizes a traced value inside "
+                           f"a jitted region (ConcretizationTypeError at "
+                           f"trace time)",
+                           hint="keep the value abstract, or mark the "
+                                "argument static")
+
+    # -- statement walk ----------------------------------------------------
+    def scan(self, fn: ast.AST, closure_taint: Set[str]) -> None:
+        tainted = set(closure_taint)
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg not in ("self", "cls"):
+                tainted.add(a.arg)
+        if isinstance(fn, ast.Lambda):
+            self._check_calls(fn.body, tainted)
+            return
+        self._scan_stmts(fn.body, tainted)
+
+    def _scan_stmts(self, stmts: Sequence[ast.stmt],
+                    tainted: Set[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.scan(stmt, tainted)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                if self._traced(stmt.test, tainted):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    self._emit(
+                        "lint/tracer-branch", stmt,
+                        f"Python `{kind}` branches on a traced value "
+                        f"inside a jitted region "
+                        f"(TracerBoolConversionError at trace time)",
+                        hint="use lax.cond / lax.while_loop / jnp.where")
+                self._check_calls(stmt.test, tainted)
+                self._scan_stmts(stmt.body, tainted)
+                self._scan_stmts(stmt.orelse, tainted)
+                continue
+            if isinstance(stmt, ast.Assert):
+                if self._traced(stmt.test, tainted):
+                    self._emit("lint/tracer-branch", stmt,
+                               "assert on a traced value inside a jitted "
+                               "region",
+                               hint="use checkify or debug.check")
+                self._check_calls(stmt.test, tainted)
+                continue
+            if isinstance(stmt, ast.For):
+                self._check_calls(stmt.iter, tainted)
+                if self._traced(stmt.iter, tainted):
+                    self._taint_target(stmt.target, tainted)
+                self._scan_stmts(stmt.body, tainted)
+                self._scan_stmts(stmt.orelse, tainted)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._check_calls(item.context_expr, tainted)
+                    if item.optional_vars is not None and \
+                            self._traced(item.context_expr, tainted):
+                        self._taint_target(item.optional_vars, tainted)
+                self._scan_stmts(stmt.body, tainted)
+                continue
+            if isinstance(stmt, _TRY_NODES):
+                self._scan_stmts(stmt.body, tainted)
+                for h in stmt.handlers:
+                    self._scan_stmts(h.body, tainted)
+                self._scan_stmts(stmt.orelse, tainted)
+                self._scan_stmts(stmt.finalbody, tainted)
+                continue
+            if isinstance(stmt, ast.Match):
+                if self._traced(stmt.subject, tainted):
+                    self._emit("lint/tracer-branch", stmt,
+                               "match on a traced value inside a jitted "
+                               "region (structural matching concretizes "
+                               "the tracer)",
+                               hint="use lax.switch / jnp.where")
+                self._check_calls(stmt.subject, tainted)
+                for case in stmt.cases:
+                    if case.guard is not None:
+                        if self._traced(case.guard, tainted):
+                            self._emit("lint/tracer-branch", case.guard,
+                                       "match-case guard on a traced "
+                                       "value inside a jitted region")
+                        self._check_calls(case.guard, tainted)
+                    self._scan_stmts(case.body, tainted)
+                continue
+            # taint propagation through assignments
+            if isinstance(stmt, ast.Assign):
+                self._check_calls(stmt.value, tainted)
+                if self._traced(stmt.value, tainted):
+                    for target in stmt.targets:
+                        self._taint_target(target, tainted)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                self._check_calls(stmt.value, tainted)
+                if self._traced(stmt.value, tainted):
+                    self._taint_target(stmt.target, tainted)
+                continue
+            if isinstance(stmt, ast.AnnAssign):
+                if stmt.value is not None:
+                    self._check_calls(stmt.value, tainted)
+                    if self._traced(stmt.value, tainted):
+                        self._taint_target(stmt.target, tainted)
+                continue
+            if isinstance(stmt, (ast.Return, ast.Expr)):
+                if stmt.value is not None:
+                    self._check_calls(stmt.value, tainted)
+                continue
+            # everything else (pass, break, imports, raise, ...): still
+            # sweep any embedded expressions for rule hits
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._check_calls(child, tainted)
+
+
+def lint_source(src: str, filename: str = "<string>",
+                suppress: Iterable[str] = ()) -> Report:
+    """Lint one Python source text; returns the findings Report."""
+    report = Report(suppress=suppress)
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        report.add("lint/tracer-branch",
+                   f"file does not parse: {e}", where=f"{filename}:"
+                   f"{e.lineno or 0}")
+        return report
+    noqa = _collect_noqa(src)
+    regions = _find_regions(tree)
+    # only lint top-level regions; nested defs are visited via recursion
+    # with the enclosing taint (a region inside a region must inherit it)
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def enclosed_in_region(node: ast.AST) -> bool:
+        p = parents.get(node)
+        while p is not None:
+            if p in regions:
+                return True
+            p = parents.get(p)
+        return False
+
+    linter = _RegionLinter(report, filename, noqa)
+    for region in sorted(regions, key=lambda n: n.lineno):
+        if not enclosed_in_region(region):
+            linter.scan(region, set())
+    return report
+
+
+def lint_paths(paths: Sequence[str],
+               suppress: Iterable[str] = ()) -> Report:
+    """Lint every ``*.py`` under the given files/directories."""
+    report = Report(suppress=suppress)
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif os.path.isfile(path):
+            # an EXPLICIT file target is linted whatever its name
+            # (extensionless scripts); only the directory walk filters
+            files.append(path)
+    for f in sorted(set(files)):
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            report.add("lint/tracer-branch", f"cannot read: {e}", where=f)
+            continue
+        report.extend(lint_source(src, filename=f, suppress=suppress))
+    return report

@@ -1,0 +1,192 @@
+"""Shared diagnostics core for the static analyzer.
+
+Every pass (shape inference, sharding validation, retrace detection, the
+AST lint) reports through the same :class:`Diagnostic` record so tooling —
+the ``python -m bigdl_tpu.analysis`` CLI, ``tools/lint_graft.py``, the
+pytest wiring — renders and filters findings uniformly.  The reference
+has no analogue: model-construction errors there surface at runtime as
+Spark executor exceptions (``LayerException`` wrapping deep inside a
+task); here XLA's abstract evaluation lets every rule run *before* the
+first expensive compile.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional
+
+__all__ = ["Severity", "Diagnostic", "Report", "RULES", "rule_severity"]
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``max(severities)`` is the worst finding."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # 'error', not 'Severity.ERROR'
+        return self.name.lower()
+
+
+#: The rule catalog: id -> (default severity, one-line description).
+#: Ids are stable API — tests assert on them and suppressions name them.
+RULES: Dict[str, tuple] = {
+    # shape/dtype inference pass (analysis.shape_pass)
+    "shape/mismatch": (Severity.ERROR,
+                       "a layer fails abstract evaluation (shape or dtype "
+                       "error) for the given input spec"),
+    "shape/f64": (Severity.ERROR,
+                  "a layer promotes a non-float64 input to float64 "
+                  "(silent 2x memory + off-MXU compute on TPU)"),
+    "shape/dead-node": (Severity.WARNING,
+                        "a graph node is fed by the inputs but contributes "
+                        "to no output (dead code in the model DAG)"),
+    "shape/input-arity": (Severity.ERROR,
+                          "the input spec arity differs from the graph's "
+                          "input-node count"),
+    # graph construction (nn.graph raises these as GraphBuildError)
+    "graph/duplicate-name": (Severity.ERROR,
+                             "two distinct modules in one Graph share an "
+                             "explicit name (lookups/stop_gradient would "
+                             "silently pick one)"),
+    "graph/cycle": (Severity.ERROR,
+                    "the module DAG contains a cycle (use ops.control "
+                    "while/cond for loops)"),
+    # sharding validation pass (analysis.sharding_pass)
+    "shard/unknown-axis": (Severity.ERROR,
+                           "a PartitionSpec names a mesh axis that does not "
+                           "exist on the mesh"),
+    "shard/indivisible": (Severity.ERROR,
+                          "a sharded dimension is not divisible by the "
+                          "product of its mesh axis sizes"),
+    "shard/rank-mismatch": (Severity.ERROR,
+                            "a PartitionSpec has more entries than the "
+                            "array has dimensions"),
+    "shard/duplicate-axis": (Severity.ERROR,
+                             "a PartitionSpec uses the same mesh axis in "
+                             "more than one dimension"),
+    "shard/rule-error": (Severity.ERROR,
+                         "a sharding-rules callable raised instead of "
+                         "returning a PartitionSpec/None"),
+    "shard/replicated-large": (Severity.WARNING,
+                               "a large parameter is fully replicated on a "
+                               "multi-device mesh (candidate for ZeRO/TP "
+                               "sharding)"),
+    # retrace detection (analysis.retrace)
+    "retrace/shape-change": (Severity.WARNING,
+                             "an argument's shape (or pytree structure) "
+                             "changed between dispatches — each new shape "
+                             "recompiles"),
+    "retrace/dtype-change": (Severity.WARNING,
+                             "an argument's dtype changed between "
+                             "dispatches — each new dtype recompiles"),
+    "retrace/weak-type": (Severity.WARNING,
+                          "an argument flipped between weak and strong "
+                          "typing between dispatches"),
+    "retrace/python-scalar": (Severity.WARNING,
+                              "a Python scalar argument alternates with an "
+                              "array (weak/strong flip) — pass a jnp array "
+                              "of fixed dtype"),
+    "retrace/recompile": (Severity.WARNING,
+                          "the jit cache grew without a visible argument "
+                          "change (hyperparameter edit or structural "
+                          "change re-traced the step)"),
+    # tracer-leak AST lint (analysis.ast_lint)
+    "lint/tracer-branch": (Severity.ERROR,
+                           "Python if/while branches on a traced value "
+                           "inside a jitted region (TracerBoolConversion "
+                           "at runtime; use lax.cond/select)"),
+    "lint/tracer-numpy": (Severity.ERROR,
+                          "a numpy host function consumes a traced value "
+                          "inside a jitted region (forces a host sync or "
+                          "fails under trace; use jnp)"),
+    "lint/host-call": (Severity.ERROR,
+                       "a host side-effect (time.*, random.*, np.random.*) "
+                       "inside a jitted region is baked in as a constant "
+                       "at trace time"),
+}
+
+
+def rule_severity(rule: str) -> Severity:
+    return RULES[rule][0] if rule in RULES else Severity.ERROR
+
+
+@dataclass
+class Diagnostic:
+    """One finding: where, what rule, how bad, and how to fix it."""
+
+    rule: str
+    message: str
+    #: module path ("features.3.conv1") or file location ("x.py:12")
+    where: str = ""
+    severity: Optional[Severity] = None
+    hint: str = ""
+
+    def __post_init__(self):
+        if self.severity is None:
+            self.severity = rule_severity(self.rule)
+
+    def format(self) -> str:
+        loc = f"{self.where}: " if self.where else ""
+        txt = f"{loc}{self.severity}: [{self.rule}] {self.message}"
+        if self.hint:
+            txt += f"\n    hint: {self.hint}"
+        return txt
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "severity": str(self.severity),
+                "where": self.where, "message": self.message,
+                "hint": self.hint}
+
+
+class Report:
+    """An ordered collection of diagnostics with filtering/suppression."""
+
+    def __init__(self, suppress: Iterable[str] = ()):
+        self.diagnostics: List[Diagnostic] = []
+        self._suppress = set(suppress)
+
+    def add(self, rule: str, message: str, where: str = "",
+            hint: str = "", severity: Optional[Severity] = None) -> None:
+        if rule in self._suppress:
+            return
+        self.diagnostics.append(
+            Diagnostic(rule=rule, message=message, where=where, hint=hint,
+                       severity=severity))
+
+    def extend(self, other: "Report") -> None:
+        for d in other.diagnostics:
+            if d.rule not in self._suppress:
+                self.diagnostics.append(d)
+
+    def __iter__(self) -> Iterator[Diagnostic]:
+        return iter(self.diagnostics)
+
+    def __len__(self) -> int:
+        return len(self.diagnostics)
+
+    def by_severity(self, severity: Severity) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.by_severity(Severity.ERROR)
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return self.by_severity(Severity.WARNING)
+
+    def rules_fired(self) -> List[str]:
+        return [d.rule for d in self.diagnostics]
+
+    def format(self) -> str:
+        lines = [d.format() for d in self.diagnostics]
+        lines.append(f"{len(self.errors)} error(s), "
+                     f"{len(self.warnings)} warning(s)")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps([d.to_json() for d in self.diagnostics], indent=2)

@@ -1,0 +1,53 @@
+"""Dispatch-observation hook points for the retrace detector.
+
+Deliberately dependency-free (no jax import): ``parallel/train_step.py``
+imports this at module load, and when no monitor is registered the
+per-dispatch cost is one falsy check.  ``analysis.retrace.trace_retraces``
+registers/unregisters monitors around a ``with`` block.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+__all__ = ["hooks_active", "register", "unregister", "dispatch_event",
+           "cache_event"]
+
+_monitors: List[Any] = []
+
+
+def hooks_active() -> bool:
+    return bool(_monitors)
+
+
+def register(monitor) -> None:
+    _monitors.append(monitor)
+
+
+def unregister(monitor) -> None:
+    try:
+        _monitors.remove(monitor)
+    except ValueError:
+        pass
+
+
+def dispatch_event(owner, kind: str, args: Dict[str, Any]) -> None:
+    """A step object is about to dispatch its compiled function with
+    ``args`` (the raw, pre-placement host arguments)."""
+    for m in list(_monitors):
+        try:
+            m.on_dispatch(owner, kind, args)
+        except Exception:  # noqa: BLE001 - observers never kill the step
+            pass
+
+
+def cache_event(owner, kind: str, cache_size) -> None:
+    """Post-dispatch: the owner's jit executable cache now holds
+    ``cache_size`` entries (None when the jit internals are unavailable)."""
+    if cache_size is None:
+        return
+    for m in list(_monitors):
+        try:
+            m.on_cache(owner, kind, cache_size)
+        except Exception:  # noqa: BLE001 - observers never kill the step
+            pass

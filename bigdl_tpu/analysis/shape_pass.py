@@ -1,0 +1,294 @@
+"""Pass 1 — static shape/dtype inference over a module tree.
+
+Walks a model with ``jax.eval_shape`` (XLA abstract evaluation: no FLOPs,
+no memory, no compile) and reports per-layer output
+``ShapeDtypeStruct``s.  ``Sequential`` chains and ``Graph`` DAGs
+(via ``Graph._topo_sort``'s node order) are walked layer-by-layer so a
+failure is pinned to the exact module path; other containers (``Concat``
+etc.) are evaluated atomically.  Rules:
+
+- ``shape/mismatch`` — a layer fails abstract evaluation for its
+  (statically inferred) input spec;
+- ``shape/f64`` — a layer whose inputs are not float64 emits float64
+  (the silent promotion the ROADMAP bans from hot paths);
+- ``shape/dead-node`` — a Graph node fed by the inputs that contributes
+  to no output;
+- ``shape/input-arity`` — the input spec does not match the graph's
+  input-node count.
+
+Also home of the fuse-pass invariant: :func:`output_spec` before/after a
+graph rewrite proves the rewrite preserved every output's shape+dtype
+(``nn/fuse.py:optimize_for_tpu`` runs this by default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.analysis.diagnostics import Report
+from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.nn.module import Module, Sequential, functional_call, state_dict
+
+__all__ = ["LayerSpec", "ShapeCheckResult", "check_shapes", "output_spec",
+           "infer_input_spec", "infer_input_output", "specs_equal",
+           "format_spec"]
+
+
+class LayerSpec(NamedTuple):
+    """One row of the per-layer report."""
+
+    path: str
+    out: Any  # pytree of jax.ShapeDtypeStruct
+
+
+class ShapeCheckResult(NamedTuple):
+    report: Report
+    layers: List[LayerSpec]
+    out: Any  # whole-model output spec pytree, or None when the walk failed
+
+
+def _as_spec(x):
+    """Concrete arrays (example inputs) -> abstract ShapeDtypeStructs."""
+    def leaf(a):
+        if isinstance(a, jax.ShapeDtypeStruct):
+            return a
+        a = jnp.asarray(a)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    return jax.tree.map(leaf, x)
+
+
+def format_spec(spec) -> str:
+    def one(s):
+        return f"{jnp.dtype(s.dtype).name}[{','.join(map(str, s.shape))}]"
+
+    leaves = jax.tree.leaves(spec)
+    if len(leaves) == 1 and spec is leaves[0]:
+        return one(leaves[0])
+    return str(jax.tree.map(one, spec))
+
+
+def _eval_module(module: Module, in_spec):
+    """Abstract-evaluate one module via its pure functional view."""
+    state = state_dict(module)
+
+    def fwd(x):
+        out, _ = functional_call(module, state, x)
+        return out
+
+    return jax.eval_shape(fwd, in_spec)
+
+
+def _has_f64(spec) -> bool:
+    return any(jnp.dtype(s.dtype) == jnp.dtype("float64")
+               for s in jax.tree.leaves(spec)
+               if hasattr(s, "dtype"))
+
+
+def _check_f64(path: str, in_spec, out, report: Report) -> None:
+    if _has_f64(out) and not _has_f64(in_spec):
+        report.add("shape/f64",
+                   f"output is float64 ({format_spec(out)}) while inputs "
+                   f"are not — silent f64 promotion",
+                   where=path,
+                   hint="cast to float32/bfloat16, or audit np.float64 "
+                        "constants in this layer")
+
+
+def _err_text(e: BaseException) -> str:
+    txt = f"{type(e).__name__}: {e}"
+    return txt if len(txt) <= 400 else txt[:400] + " ..."
+
+
+def _walk(module: Module, in_spec, path: str, rows: List[LayerSpec],
+          report: Report):
+    """Returns the module's output spec pytree, or None after reporting."""
+    if type(module) is Sequential or (
+            isinstance(module, Sequential) and
+            type(module).update_output is Sequential.update_output):
+        spec = in_spec
+        for name, child in module.__dict__["_modules"].items():
+            child_path = f"{path}.{name}" if path else name
+            spec = _walk(child, spec, child_path, rows, report)
+            if spec is None:
+                return None
+        return spec
+    if isinstance(module, Graph):
+        return _walk_graph(module, in_spec, path, rows, report)
+    try:
+        out = _eval_module(module, in_spec)
+    except Exception as e:  # noqa: BLE001 - every trace error is a finding
+        report.add("shape/mismatch",
+                   f"abstract evaluation failed for input "
+                   f"{format_spec(_as_spec(in_spec))}: {_err_text(e)}",
+                   where=path or module.get_name(),
+                   hint="the layer's expected input shape/dtype disagrees "
+                        "with what the model feeds it")
+        return None
+    rows.append(LayerSpec(path or module.get_name(), out))
+    _check_f64(path or module.get_name(), _as_spec(in_spec), out, report)
+    return out
+
+
+def _graph_dead_nodes(g: Graph) -> List[str]:
+    """Nodes reachable forward from the inputs that are not ancestors of
+    any output (``_topo_sort`` only keeps output ancestors)."""
+    live = {n.id for n in g._sorted} | {n.id for n in g.input_nodes}
+    dead, seen, stack = [], set(), list(g.input_nodes)
+    while stack:
+        n = stack.pop()
+        if n.id in seen:
+            continue
+        seen.add(n.id)
+        if n.id not in live:
+            dead.append(n.element.get_name())
+        stack.extend(n.next)
+    return dead
+
+
+def _walk_graph(g: Graph, in_spec, path: str, rows: List[LayerSpec],
+                report: Report):
+    inputs = list(in_spec) if isinstance(in_spec, (list, tuple)) \
+        else [in_spec]
+    if len(inputs) != len(g.input_nodes):
+        report.add("shape/input-arity",
+                   f"graph has {len(g.input_nodes)} input node(s) but the "
+                   f"input spec provides {len(inputs)}",
+                   where=path or g.get_name())
+        return None
+    for name in _graph_dead_nodes(g):
+        report.add("shape/dead-node",
+                   f"node {name!r} is fed by the graph inputs but reaches "
+                   f"no output — it will never execute",
+                   where=f"{path}.{name}" if path else name,
+                   hint="remove the node or add it to the graph outputs")
+    specs = {}
+    for n, s in zip(g.input_nodes, inputs):
+        specs[n.id] = s
+    input_ids = {n.id for n in g.input_nodes}
+    for n in g._sorted:
+        if n.id in input_ids:
+            continue
+        gathered = []
+        for p, idx in n.prev:
+            v = specs[p.id]
+            if idx is not None:
+                v = v[idx]
+            gathered.append(v)
+        node_in = gathered[0] if len(gathered) == 1 else gathered
+        node_path = f"{path}.{n.element.get_name()}" if path \
+            else n.element.get_name()
+        out = _walk(n.element, node_in, node_path, rows, report)
+        if out is None:
+            return None
+        specs[n.id] = out
+    outs = [specs[o.id] for o in g.output_nodes]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def check_shapes(model: Module, input_spec, suppress=()) -> ShapeCheckResult:
+    """Run the shape/dtype pass; ``input_spec`` is a (pytree of)
+    ``jax.ShapeDtypeStruct`` or example arrays."""
+    report = Report(suppress=suppress)
+    rows: List[LayerSpec] = []
+    spec = _as_spec(input_spec)
+    out = _walk(model, spec, "", rows, report)
+    return ShapeCheckResult(report, rows, out)
+
+
+def output_spec(model: Module, input_spec) -> Optional[Any]:
+    """Whole-model output spec pytree via one abstract evaluation, or
+    ``None`` when the model cannot be abstractly evaluated for this input
+    (nothing to prove then)."""
+    try:
+        return _eval_module(model, _as_spec(input_spec))
+    except Exception:  # noqa: BLE001 - "cannot prove" is a valid outcome
+        return None
+
+
+def specs_equal(a, b) -> bool:
+    if a is None or b is None:
+        return False
+    ta, tb = jax.tree.structure(a), jax.tree.structure(b)
+    if ta != tb:
+        return False
+    return all(tuple(x.shape) == tuple(y.shape)
+               and jnp.dtype(x.dtype) == jnp.dtype(y.dtype)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# -- input-spec inference ---------------------------------------------------
+
+#: (H, W) candidates for convolutional models, most common first.
+_IMG_SIZES: Tuple[Tuple[int, int], ...] = ((224, 224), (32, 32), (28, 28),
+                                           (299, 299))
+
+
+def _first_leaf(module: Module) -> Optional[Module]:
+    from bigdl_tpu.nn.module import Container
+
+    m = module
+    while isinstance(m, Container):
+        if isinstance(m, Graph):
+            nxt = m.input_nodes[0].next if m.input_nodes else []
+            if not nxt:
+                return None
+            m = nxt[0].element
+            continue
+        layers = m.layers
+        if not layers:
+            return None
+        m = layers[0]
+    return m
+
+
+def infer_input_spec(model: Module, batch: int = 2) -> Optional[Any]:
+    """Best-effort canonical input spec from the model's first consuming
+    layer — used when a caller (``optimize_for_tpu``) has no example
+    input.  Returns ``None`` when no candidate abstractly evaluates; the
+    model-zoo registry (``models/registry.py``) holds exact specs."""
+    found = infer_input_output(model, batch)
+    return found[0] if found is not None else None
+
+
+def infer_input_output(model: Module, batch: int = 2
+                       ) -> Optional[Tuple[Any, Any]]:
+    """Like :func:`infer_input_spec` but returns ``(input_spec,
+    output_spec)`` — the successful candidate's abstract evaluation is the
+    proof it fits, so callers needing both (the fuse invariant) avoid a
+    second whole-model walk."""
+    from bigdl_tpu.nn.layers.conv import SpatialConvolution
+
+    leaf = _first_leaf(model)
+    if leaf is None:
+        return None
+    candidates: List[Any] = []
+    if isinstance(leaf, SpatialConvolution):
+        c = leaf.n_input_plane
+        for h, w in _IMG_SIZES:
+            shape = (batch, c, h, w) if leaf.format == "NCHW" \
+                else (batch, h, w, c)
+            candidates.append(jax.ShapeDtypeStruct(shape, jnp.float32))
+    else:
+        d = leaf.__dict__
+        if "size" in d and isinstance(d["size"], (tuple, list)):  # Reshape
+            import numpy as np
+
+            n = int(np.prod(d["size"]))
+            candidates.append(jax.ShapeDtypeStruct((batch, n), jnp.float32))
+        elif "n_input" in d or "input_size" in d:  # Linear-like
+            n = d.get("n_input", d.get("input_size"))
+            if isinstance(n, int):
+                candidates.append(
+                    jax.ShapeDtypeStruct((batch, n), jnp.float32))
+        elif "n_index" in d or "vocab_size" in d:  # LookupTable-like
+            candidates.append(
+                jax.ShapeDtypeStruct((batch, 16), jnp.int32))
+    for spec in candidates:
+        out = output_spec(model, spec)
+        if out is not None:
+            return spec, out
+    return None

@@ -1,0 +1,186 @@
+"""Pass 2 — sharding validation: PartitionSpecs vs. the actual mesh.
+
+The cross-replica weight-update sharding literature (arxiv 2004.13336)
+shows sharding-spec mistakes are a *silent* correctness/perf hazard: an
+unknown axis name or an indivisible dim either errors deep inside pjit
+or quietly degrades to replication.  This pass checks specs — from a raw
+``{path: PartitionSpec}`` dict, or pulled out of a live ``TrainStep``'s
+parameter metadata (``check_train_step``) — against the mesh *before*
+compile.  Rules: ``shard/unknown-axis``, ``shard/duplicate-axis``,
+``shard/indivisible``, ``shard/rank-mismatch``, ``shard/rule-error``,
+``shard/replicated-large``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.analysis.diagnostics import Report
+
+__all__ = ["check_partition_specs", "check_sharding_rules",
+           "check_train_step", "REPLICATED_LARGE_THRESHOLD"]
+
+#: parameters at/above this element count trigger shard/replicated-large
+#: when fully replicated on a multi-device mesh (1M f32 elems = 4 MiB per
+#: device, times every device on the mesh).
+REPLICATED_LARGE_THRESHOLD = 1 << 20
+
+
+def _spec_entries(spec) -> Tuple:
+    """PartitionSpec -> tuple of per-dim entries (None | axis | tuple)."""
+    return tuple(spec)
+
+
+def _axes_of(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def _shape_of(arr) -> Optional[Tuple[int, ...]]:
+    if arr is None:
+        return None
+    if hasattr(arr, "shape"):
+        return tuple(arr.shape)
+    if isinstance(arr, (tuple, list)):
+        return tuple(int(s) for s in arr)
+    return None
+
+
+def check_partition_specs(mesh, specs: Dict[str, Any],
+                          shapes: Optional[Dict[str, Any]] = None,
+                          suppress: Iterable[str] = (),
+                          large_threshold: int = REPLICATED_LARGE_THRESHOLD,
+                          ) -> Report:
+    """Validate ``{name: PartitionSpec}`` against ``mesh``.
+
+    ``shapes`` maps the same names to arrays (or shape tuples); without it
+    only axis-name validity can be checked.
+    """
+    report = Report(suppress=suppress)
+    mesh_axes = dict(zip(mesh.axis_names,
+                         (int(s) for s in mesh.devices.shape)))
+    multi_device = int(np.prod(mesh.devices.shape)) > 1
+    for name, spec in specs.items():
+        entries = _spec_entries(spec)
+        shape = _shape_of((shapes or {}).get(name))
+        seen_axes = []
+        for dim, entry in enumerate(entries):
+            for ax in _axes_of(entry):
+                if ax not in mesh_axes:
+                    report.add(
+                        "shard/unknown-axis",
+                        f"PartitionSpec{tuple(entries)} names mesh axis "
+                        f"{ax!r} but the mesh has axes "
+                        f"{sorted(mesh_axes)}",
+                        where=name,
+                        hint="axis names must match the mesh built by "
+                             "parallel/mesh.py make_mesh()")
+                    continue
+                if ax in seen_axes:
+                    report.add(
+                        "shard/duplicate-axis",
+                        f"PartitionSpec{tuple(entries)} uses mesh axis "
+                        f"{ax!r} more than once",
+                        where=name)
+                seen_axes.append(ax)
+            if shape is not None and dim < len(shape):
+                div = 1
+                for ax in _axes_of(entry):
+                    div *= mesh_axes.get(ax, 1)
+                if div > 1 and shape[dim] % div != 0:
+                    report.add(
+                        "shard/indivisible",
+                        f"dim {dim} of shape {shape} is split over "
+                        f"{_axes_of(entry)} (total {div} shards) but "
+                        f"{shape[dim]} % {div} != 0",
+                        where=name,
+                        hint="pad the dimension or move the sharding to "
+                             "a divisible axis")
+        if shape is not None and len(entries) > len(shape):
+            report.add(
+                "shard/rank-mismatch",
+                f"PartitionSpec{tuple(entries)} has {len(entries)} "
+                f"entries but the array is rank {len(shape)}",
+                where=name)
+        if shape is not None and multi_device \
+                and all(not _axes_of(e) for e in entries):
+            n = int(np.prod(shape)) if shape else 0
+            if n >= large_threshold:
+                report.add(
+                    "shard/replicated-large",
+                    f"parameter of {n} elements is fully replicated on a "
+                    f"{dict(mesh_axes)} mesh",
+                    where=name,
+                    hint="consider parameter_sync='sharded'/'fsdp' or an "
+                         "extra_sharding_rules TP spec for this weight")
+    return report
+
+
+def check_sharding_rules(mesh, params, rules,
+                         suppress: Iterable[str] = ()) -> Report:
+    """Pre-flight validation of an ``extra_sharding_rules`` callable
+    against a ``{path: array}`` param dict *before* TrainStep
+    construction — a bad axis name would otherwise explode deep inside
+    ``device_put``/pjit with no parameter path in the error."""
+    report = Report(suppress=suppress)
+    specs: Dict[str, Any] = {}
+    shapes: Dict[str, Any] = {}
+    for path, arr in params.items():
+        try:
+            spec = rules(path, arr)
+        except Exception as e:  # noqa: BLE001 - rule bugs are findings
+            report.add("shard/rule-error",
+                       f"sharding rule raised for this parameter: "
+                       f"{type(e).__name__}: {e}", where=path)
+            continue
+        if spec is not None:
+            specs[path] = spec
+            shapes[path] = arr
+    report.extend(check_partition_specs(mesh, specs, shapes,
+                                        suppress=suppress))
+    return report
+
+
+def check_train_step(step, suppress: Iterable[str] = ()) -> Report:
+    """Validate a ``TrainStep``'s parameter shardings (the specs its
+    ``_param_sharding``/``extra_sharding_rules`` machinery will request)
+    against its mesh — before the first compile."""
+    report = Report(suppress=suppress)
+    mesh = step.mesh
+    if mesh is None:
+        return report
+    specs: Dict[str, Any] = {}
+    shapes: Dict[str, Any] = {}
+    for path, arr in step.params.items():
+        shapes[path] = arr
+        rule_spec = None
+        if step.extra_sharding_rules is not None:
+            try:
+                rule_spec = step.extra_sharding_rules(path, arr)
+            except Exception as e:  # noqa: BLE001 - rule bugs are findings
+                report.add("shard/rule-error",
+                           f"extra_sharding_rules raised for this "
+                           f"parameter: {type(e).__name__}: {e}",
+                           where=path)
+                continue
+        if rule_spec is not None:
+            specs[path] = rule_spec
+        else:
+            sharding = step._param_sharding(path, arr)
+            spec = getattr(sharding, "spec", None)
+            if spec is None:
+                continue
+            # pad the spec to the array rank so replicated-large sees a
+            # per-dim view
+            entries = tuple(spec) + (None,) * (arr.ndim - len(tuple(spec)))
+            from jax.sharding import PartitionSpec as P
+
+            specs[path] = P(*entries)
+    report.extend(check_partition_specs(mesh, specs, shapes,
+                                        suppress=suppress))
+    return report

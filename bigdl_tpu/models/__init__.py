@@ -1,4 +1,11 @@
-"""bigdl_tpu.models — model zoo (SURVEY §2.13)."""
+"""bigdl_tpu.models — model zoo (SURVEY §2.13).
+
+``models.registry`` maps zoo names to builders + canonical input specs;
+it backs both the train/test/perf CLI (``models/cli.py``) and the static
+analyzer (``python -m bigdl_tpu.analysis <name>``).
+"""
+
+from bigdl_tpu.models import registry  # noqa: F401
 
 from bigdl_tpu.models.autoencoder import build_autoencoder  # noqa: F401
 from bigdl_tpu.models.inception import (  # noqa: F401

@@ -31,36 +31,21 @@ import numpy as np
 
 
 def _build_model(name: str, num_classes: int):
-    from bigdl_tpu import models
+    # one shared name->builder table with the static analyzer
+    # (python -m bigdl_tpu.analysis), see models/registry.py
+    from bigdl_tpu.models import registry
 
-    builders = {
-        "lenet": lambda: models.build_lenet5(num_classes or 10),
-        "vgg16": lambda: models.build_vgg16(num_classes or 1000),
-        "vgg19": lambda: models.build_vgg19(num_classes or 1000),
-        "vgg_cifar": lambda: models.build_vgg_for_cifar10(num_classes or 10),
-        "inception_v1": lambda: models.build_inception_v1(
-            num_classes or 1000),
-        "inception_v2": lambda: models.build_inception_v2(
-            num_classes or 1000),
-        "resnet": lambda: models.build_resnet_cifar(20, num_classes or 10),
-        "resnet50": lambda: models.build_resnet(50, num_classes or 1000),
-        "autoencoder": lambda: models.build_autoencoder(),
-        "lstm": lambda: models.build_lstm_classifier(LSTM_VOCAB,
-                                                     class_num=num_classes
-                                                     or 2),
-        "transformer": lambda: models.build_transformer_lm(
-            vocab_size=num_classes or 256),
-    }
-    if name not in builders:
+    if name not in registry.MODELS:
         raise SystemExit(f"unknown --model {name!r}; choose from "
-                         f"{sorted(builders)}")
-    return builders[name]()
+                         f"{registry.model_names()}")
+    return registry.build_model(name, num_classes)
 
 
 #: sequence models take [batch, time] int token ids, not images.
 SEQ_MODELS = ("lstm", "transformer")
-LSTM_VOCAB = 5000
-LM_SEQ_LEN = 128
+# shared with the analyzer's canonical input specs (models/registry.py)
+from bigdl_tpu.models.registry import (  # noqa: E402
+    LM_SEQ_LEN, LSTM_SEQ_LEN, LSTM_VOCAB)
 
 
 @functools.lru_cache(maxsize=2)
@@ -97,7 +82,7 @@ def _load_token_data(model_name: str, folder: Optional[str], split: str,
     """Token-shaped data for the sequence models: news20 text run through
     the text pipeline (tokenize -> dictionary -> fixed-length ids).
 
-    ``lstm``  -> (tokens [N,200] int, class labels [N]);
+    ``lstm``  -> (tokens [N,LSTM_SEQ_LEN] int, class labels [N]);
     ``transformer`` -> (tokens [N,T] int, next-token targets [N,T])."""
     dic, docs, labels = _news20_corpus(folder, vocab_size)
     # deterministic split: every 5th doc is test, the rest train
@@ -106,7 +91,7 @@ def _load_token_data(model_name: str, folder: Optional[str], split: str,
     ids = [np.asarray([dic.index(w) + 1 for w in docs[i]], np.int32)
            for i in keep]  # reserve 0 for padding
     if model_name == "lstm":
-        seq_len = 200
+        seq_len = LSTM_SEQ_LEN
         x = np.zeros((len(ids), seq_len), np.int32)
         for i, t in enumerate(ids):
             x[i, :min(len(t), seq_len)] = t[:seq_len]
@@ -265,7 +250,8 @@ def cmd_perf(args) -> None:
     criterion = nn.ClassNLLCriterion()
     if args.model in SEQ_MODELS:
         if args.model == "lstm":
-            x = rng.integers(0, LSTM_VOCAB, (args.batch_size, 200),
+            x = rng.integers(0, LSTM_VOCAB,
+                             (args.batch_size, LSTM_SEQ_LEN),
                              dtype=np.int32)
             y = rng.integers(0, num_classes, args.batch_size)
         else:

@@ -37,17 +37,63 @@ from bigdl_tpu.nn.layers.shape import Narrow
 from bigdl_tpu.nn.module import Container, Module, Sequential
 
 __all__ = ["optimize_for_tpu", "merge_sibling_convs", "fold_batchnorm",
-           "space_to_depth_input"]
+           "space_to_depth_input", "ShapeInvariantError"]
 
 
-def optimize_for_tpu(model: Module) -> Module:
+class ShapeInvariantError(RuntimeError):
+    """A fusion pass changed the model's output shapes/dtypes — the
+    rewrite is wrong, refuse to hand back the broken model."""
+
+
+def optimize_for_tpu(model: Module, example_input=None,
+                     check: bool = True) -> Module:
     """Run the training-safe graph passes; ALWAYS rebind the result
     (``model = optimize_for_tpu(model)``): most rewrites mutate in place,
     but when the model root itself is an eligible input conv,
     ``space_to_depth_input`` must return a new root.  (``fold_batchnorm``
-    is inference-only and therefore NOT included here.)"""
+    is inference-only and therefore NOT included here.)
+
+    By default every run proves the SHAPE INVARIANT: the model's output
+    ``ShapeDtypeStruct``s (via ``jax.eval_shape`` — abstract, no compile)
+    are captured before the passes and re-checked after; a mismatch
+    raises :class:`ShapeInvariantError` instead of handing back a
+    silently-broken model.  ``example_input`` pins the input spec; when
+    omitted it is inferred from the model's first layer
+    (``analysis.infer_input_spec``), and models whose input cannot be
+    inferred skip the check.  ``check=False`` disables it."""
+    in_spec = before = None
+    if check:
+        from bigdl_tpu.analysis.shape_pass import (format_spec,
+                                                   infer_input_output,
+                                                   output_spec, specs_equal)
+
+        if example_input is not None:
+            in_spec = example_input
+            before = output_spec(model, in_spec)
+            if before is None:
+                # the caller PINNED this spec — a model that cannot even
+                # evaluate for it is already broken; skipping silently
+                # would break the "every run proves the invariant" contract
+                raise ShapeInvariantError(
+                    f"model fails abstract evaluation for the given "
+                    f"example_input {format_spec(in_spec)} — nothing to "
+                    f"prove; run analysis.check_shapes for the per-layer "
+                    f"diagnosis")
+        else:
+            found = infer_input_output(model)  # one walk proves the fit
+            if found is not None:
+                in_spec, before = found
     model = merge_sibling_convs(model)  # may REBUILD a Graph root
-    return space_to_depth_input(model)
+    model = space_to_depth_input(model)
+    if before is not None:
+        after = output_spec(model, in_spec)
+        if not specs_equal(before, after):
+            raise ShapeInvariantError(
+                f"optimize_for_tpu changed the model's output spec: "
+                f"{format_spec(before)} -> "
+                f"{'<eval failed>' if after is None else format_spec(after)}"
+                f" (input {format_spec(in_spec)})")
+    return model
 
 
 def merge_sibling_convs(model: Module) -> Module:

@@ -27,7 +27,22 @@ import jax.numpy as jnp
 
 from bigdl_tpu.nn.module import Container, Identity, Module
 
-__all__ = ["Node", "Input", "Graph", "node_from_module"]
+__all__ = ["Node", "Input", "Graph", "GraphBuildError", "node_from_module"]
+
+
+class GraphBuildError(ValueError):
+    """Graph construction rejected the DAG.  The message carries the
+    analyzer rule id (``graph/cycle`` or ``graph/duplicate-name``) and
+    the offending node names, Diagnostic-style, so the error is
+    actionable without re-running under the checker."""
+
+    def __init__(self, rule: str, message: str, hint: str = ""):
+        text = f"[{rule}] {message}"
+        if hint:
+            text += f"\n    hint: {hint}"
+        super().__init__(text)
+        self.rule = rule
+        self.hint = hint
 
 
 class Node:
@@ -73,16 +88,28 @@ def Input(name: Optional[str] = None) -> Node:
 def _topo_sort(outputs: List[Node]) -> List[Node]:
     order: List[Node] = []
     seen: Dict[int, int] = {}  # id -> 0 visiting, 1 done
+    path: List[Node] = []  # current DFS stack, for the cycle message
 
     def visit(n: Node):
         state = seen.get(n.id)
         if state == 1:
             return
         if state == 0:
-            raise ValueError("Graph contains a cycle; use ops.control for loops")
+            # report the actual cycle: the path suffix from n back to n
+            ids = [p.id for p in path]
+            start = ids.index(n.id) if n.id in ids else 0
+            names = [p.element.get_name() for p in path[start:]] + \
+                [n.element.get_name()]
+            raise GraphBuildError(
+                "graph/cycle",
+                "Graph contains a cycle: " + " -> ".join(names),
+                hint="XLA graphs are acyclic; use ops.control "
+                     "while_modules/cond_modules for loops")
         seen[n.id] = 0
+        path.append(n)
         for p, _ in n.prev:
             visit(p)
+        path.pop()
         seen[n.id] = 1
         order.append(n)
 
@@ -106,8 +133,24 @@ class Graph(Container):
             if not _is_without_input(n.element):
                 raise ValueError(f"node {n} has no inputs and is not an Input node")
         self._stop_gradient: set = set()
+        # two DISTINCT modules sharing an explicit name would make name
+        # lookups (__getitem__, stop_gradient) silently pick one — reject
+        # with every collision listed (one round-trip, analyzer-style)
+        by_name: Dict[str, set] = {}
+        for n in self._sorted:
+            name = n.element.__dict__["_name"]
+            if name:
+                by_name.setdefault(name, set()).add(id(n.element))
+        dupes = sorted(k for k, ids in by_name.items() if len(ids) > 1)
+        if dupes:
+            raise GraphBuildError(
+                "graph/duplicate-name",
+                f"distinct modules share explicit names: {dupes}",
+                hint="set_name() each module uniquely (re-using one "
+                     "module object for weight sharing is fine)")
         # register the modules so parameters are discoverable; keys must be
-        # unique even when user names collide, or params silently vanish
+        # unique even when names repeat via weight sharing (same element
+        # wrapped by several nodes), or params silently vanish
         used = set()
         for i, n in enumerate(self._sorted):
             if n.id in input_ids:

@@ -459,21 +459,32 @@ def state_dict(module: Module, kind: str = "all", prefix: str = "") -> Dict[str,
 
 
 def load_state_dict(module: Module, state: Dict[str, Any], strict: bool = True):
+    """Load ``{path: array}`` into the module tree.
+
+    Under ``strict=True`` ALL missing and unexpected keys are collected
+    and reported in ONE ``KeyError`` (instead of failing on the first),
+    so a checkpoint/analyzer mismatch is actionable in one shot."""
     own = state_dict(module)
+    unexpected = [path for path in state if path not in own]
     for path, v in state.items():
-        if path not in own and not strict:
+        if path not in own:
             continue
         mod, leaf = _resolve(module, path)
         if leaf in mod.__dict__["_params"]:
             mod.__dict__["_params"][leaf] = v if isinstance(v, jax.Array) else jnp.asarray(v)
         elif leaf in mod.__dict__["_buffers"]:
             mod.__dict__["_buffers"][leaf] = v if isinstance(v, jax.Array) else jnp.asarray(v)
-        elif strict:
-            raise KeyError(f"no parameter/buffer {path!r} in {type(module).__name__}")
     if strict:
-        missing = set(own) - set(state)
-        if missing:
-            raise KeyError(f"missing keys in state: {sorted(missing)}")
+        missing = sorted(set(own) - set(state))
+        if missing or unexpected:
+            parts = []
+            if missing:
+                parts.append(f"missing keys in state: {missing}")
+            if unexpected:
+                parts.append(
+                    f"no parameter/buffer in {type(module).__name__} for "
+                    f"unexpected keys: {sorted(unexpected)}")
+            raise KeyError("; ".join(parts))
 
 
 def _clear_outputs(module: Module):

@@ -33,10 +33,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.analysis import hooks as _hooks
 from bigdl_tpu.nn.module import Module, functional_call, state_dict, _resolve
 from bigdl_tpu.parallel.mesh import (DATA_AXIS, data_sharding,
                                      mesh_process_count, replicated,
                                      shard_local_batch)
+
+
+def _jit_cache_size(compiled) -> Optional[int]:
+    """Executable-cache entry count of a jit-wrapped callable (None when
+    the jit internals don't expose it)."""
+    try:
+        return int(compiled._cache_size())
+    except Exception:  # noqa: BLE001 - observability only, never fail
+        return None
 
 __all__ = ["TrainStep", "bf16_truncate", "EvalStep"]
 
@@ -314,17 +324,38 @@ class TrainStep:
         Single-host callers pass the GLOBAL batch; multi-host callers pass
         this process's LOCAL shard of it (per-process data sharding, the
         reference's per-node partition feeding)."""
+        active = _hooks.hooks_active()
+        if active:  # retrace detector sees the RAW args
+            _hooks.dispatch_event(self, "TrainStep.run",
+                                  {"x": x, "y": y, "key": key})
         x, y = self._shard_batch(x, y)
+        if active:  # set only once run_sharded is definitely next
+            self._dispatch_observed = "TrainStep.run"
         return self.run_sharded(x, y, key)
 
     def run_sharded(self, x, y, key):
         """One iteration over batch arrays already placed on the mesh
         (``_shard_batch``) — lets the host loop time the h2d transfer and
         the dispatch as separate Metrics stages."""
+        # direct callers (the Optimizer's h2d/dispatch Metrics split)
+        # bypass run(); the retrace detector still needs to see the args
+        # or every recompile is misattributed as retrace/recompile.  A
+        # DISTINCT event kind keeps the raw-args view from run() and the
+        # mesh-placed view here from diffing against each other.
+        kind = getattr(self, "_dispatch_observed", None)
+        if kind is None:
+            kind = "TrainStep.run_sharded"
+            if _hooks.hooks_active():
+                _hooks.dispatch_event(self, kind,
+                                      {"x": x, "y": y, "key": key})
+        self._dispatch_observed = None
         if self._compiled is None:
             self._compiled = self._build()
         self.params, self.opt_state, self.buffers, loss = self._compiled(
             self.params, self.opt_state, self.buffers, x, y, key)
+        if _hooks.hooks_active():
+            _hooks.cache_event(self, kind,
+                               _jit_cache_size(self._compiled))
         return loss
 
     def _shard_batch(self, x, y, stacked: bool = False):
@@ -360,6 +391,13 @@ class TrainStep:
     def run_scan(self, x, y, key, n: int, stacked: bool = False):
         """Run ``n`` training iterations in one dispatch; returns the
         per-iteration losses (device array).  See ``_build_scan``."""
+        if _hooks.hooks_active():
+            # n/stacked are compile-key VALUES: changing either rebuilds
+            # the scan, so the retrace detector must see them by value
+            _hooks.dispatch_event(self, "TrainStep.run_scan",
+                                  {"x": x, "y": y, "key": key,
+                                   "static:n": n,
+                                   "static:stacked": stacked})
         cache_key = (n, stacked)
         if getattr(self, "_scan_cache", None) is None \
                 or self._scan_cache[0] != cache_key:
@@ -438,6 +476,8 @@ class EvalStep:
         return jax.jit(fwd)
 
     def run(self, x):
+        if _hooks.hooks_active():
+            _hooks.dispatch_event(self, "EvalStep.run", {"x": x})
         if self._compiled is None:
             self._compiled = self._build()
         state = state_dict(self.model)
@@ -447,4 +487,8 @@ class EvalStep:
                     jnp.asarray(a), data_sharding(self.mesh, np.ndim(a), self.batch_axes)), x)
         else:
             x = jax.tree.map(jnp.asarray, x)
-        return self._compiled(state, x)
+        out = self._compiled(state, x)
+        if _hooks.hooks_active():
+            _hooks.cache_event(self, "EvalStep.run",
+                               _jit_cache_size(self._compiled))
+        return out

@@ -491,3 +491,68 @@ def test_fold_batchnorm_skips_non_adjacent():
                           _bn_with_stats(5, 3))
     fold_batchnorm(model)
     assert len(model.layers) == 3  # ReLU between conv and BN: no fold
+
+
+# --------------------------------------------------------------------------
+# shape-invariant wiring (bigdl_tpu.analysis shape pass around the rewrites)
+# --------------------------------------------------------------------------
+
+def test_optimize_for_tpu_shape_invariant_resnet_inception():
+    """Every fusion pass must prove it preserved output shapes/dtypes:
+    before/after specs via the analyzer's abstract evaluation must be
+    identical for the models the rewrites exist for."""
+    from bigdl_tpu.analysis.shape_pass import output_spec, specs_equal
+    from bigdl_tpu.models import build_resnet
+
+    for build, spec in (
+            (lambda: build_resnet(18, 100),
+             jax.ShapeDtypeStruct((2, 3, 224, 224), jnp.float32)),
+            (lambda: build_inception_v1(100),
+             jax.ShapeDtypeStruct((2, 3, 224, 224), jnp.float32))):
+        RNG.set_seed(3)
+        before = output_spec(build(), spec)
+        assert before is not None
+        RNG.set_seed(3)
+        fused = optimize_for_tpu(build(), example_input=spec)
+        after = output_spec(fused, spec)
+        assert specs_equal(before, after), (before, after)
+
+
+def test_optimize_for_tpu_invariant_catches_broken_pass(monkeypatch):
+    """The default-on invariant must actually trip when a rewrite breaks
+    the model (guards against the check becoming a stub)."""
+    from bigdl_tpu.nn import fuse as fuse_mod
+    from bigdl_tpu.nn.fuse import ShapeInvariantError
+
+    def breaking_pass(model):
+        return nn.Sequential(model, nn.Narrow(1, 0, 1))  # chops channels
+
+    monkeypatch.setattr(fuse_mod, "space_to_depth_input", breaking_pass)
+    RNG.set_seed(4)
+    block = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1), nn.ReLU(True))
+    with pytest.raises(ShapeInvariantError):
+        fuse_mod.optimize_for_tpu(
+            block, example_input=jax.ShapeDtypeStruct((2, 3, 16, 16),
+                                                      jnp.float32))
+
+
+def test_optimize_for_tpu_rejects_uneval_example_input():
+    """An explicitly pinned example_input the model cannot abstractly
+    evaluate must raise, not silently skip the invariant."""
+    from bigdl_tpu.nn.fuse import ShapeInvariantError
+
+    RNG.set_seed(6)
+    block = nn.Sequential(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+    with pytest.raises(ShapeInvariantError, match="abstract evaluation"):
+        optimize_for_tpu(block, example_input=jax.ShapeDtypeStruct(
+            (2, 5, 16, 16), jnp.float32))  # 5 channels into a 3-ch conv
+
+
+def test_optimize_for_tpu_infers_spec_by_default():
+    """No example input: the invariant still runs via inferred specs (the
+    bench/tools call pattern `optimize_for_tpu(model)`)."""
+    RNG.set_seed(5)
+    model = optimize_for_tpu(build_inception_v1(100))
+    out = model.evaluate().forward(jnp.ones((1, 3, 224, 224)))
+    assert out.shape == (1, 100)

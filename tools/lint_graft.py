@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""CI gate: tracer-leak AST lint over the repo's Python sources.
+
+Thin wrapper over ``bigdl_tpu.analysis.lint_sources`` (pass 4 of the
+static analyzer) pinned to the repo's source roots; exits nonzero when
+any error-severity finding fires, so CI fails on a freshly introduced
+tracer leak.  The same check runs inside the tier-1 pytest run via
+``tests/test_lint_clean.py``.
+
+Usage::
+
+    python tools/lint_graft.py                 # bigdl_tpu/ tools/ examples/
+    python tools/lint_graft.py mypkg/ file.py  # explicit targets
+    python tools/lint_graft.py --warnings-ok   # ignore warnings
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bigdl_tpu.analysis.ast_lint import DEFAULT_LINT_DIRS, lint_paths  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="tracer-leak lint (python -m bigdl_tpu.analysis --lint)")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: "
+                        f"{' '.join(DEFAULT_LINT_DIRS)})")
+    p.add_argument("--suppress", action="append", default=[],
+                   metavar="RULE")
+    p.add_argument("--warnings-ok", action="store_true",
+                   help="exit 0 even when warnings fire (errors still "
+                        "fail)")
+    args = p.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(repo, d) for d in DEFAULT_LINT_DIRS]
+    report = lint_paths(paths, suppress=args.suppress)
+    print(report.format())
+    if report.errors:
+        return 1
+    if report.warnings and not args.warnings_ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
