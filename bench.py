@@ -227,6 +227,15 @@ def _flash_attn_flops(name, batch):
 
 
 def run_config(name, batch, iters):
+    from bigdl_tpu import telemetry
+
+    with telemetry.span(f"bench/{name}", batch=batch, iters=iters):
+        return _run_config_timed(name, batch, iters)
+
+
+def _run_config_timed(name, batch, iters):
+    from bigdl_tpu import telemetry
+
     step, x, y = make_step(name, batch)
 
     # ALL timed iterations run inside ONE dispatch (lax.scan over the
@@ -269,6 +278,13 @@ def run_config(name, batch, iters):
     wall = time.perf_counter() - t0
 
     rate = batch * iters / wall
+    # same numbers, second consumer: the telemetry event log (when a run
+    # is active) carries the stage split + throughput next to the
+    # aot_scan compile/device_facts events TrainStep already emitted
+    telemetry.stage("h2d", t_h2d - t0)
+    telemetry.stage("dispatch", t_dispatch - t_h2d)
+    telemetry.stage("device", wall - (t_dispatch - t0))
+    telemetry.counter(f"bench/{name}/images_per_sec", rate)
     out = {"images_per_sec": round(rate, 2), "batch": batch,
            # host-loop stage breakdown (optim/Metrics.scala:31-130
            # re-scope; see docs/straggler.md): compile / h2d / dispatch /
@@ -535,6 +551,18 @@ def _init_backend_or_die():
 
 def main():
     _init_backend_or_die()
+    # BIGDL_TELEMETRY routes the sweep's per-config stage timings,
+    # compiles, and device facts into one JSONL run log (the instrumented
+    # path replacing this file's former ad-hoc-only timing story)
+    from bigdl_tpu import telemetry
+
+    with telemetry.maybe_run(meta={"cmd": "bench"}) as owned_log:
+        _sweep()
+    if owned_log:
+        print(f"# telemetry run log: {owned_log}", file=sys.stderr)
+
+
+def _sweep():
     iters = int(os.environ.get("BENCH_ITERS", "24"))
     _start_wedge_watchdog(iters)
     cfgs = _configs()

@@ -226,8 +226,14 @@ def cmd_test(args) -> None:
             nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True))]
     else:
         methods = [optim.Top1Accuracy(), optim.Top5Accuracy()]
-    res = optim.Evaluator(model, batch_size=args.batch_size).evaluate(
-        samples, methods)
+    from bigdl_tpu import telemetry
+
+    with telemetry.maybe_run(meta={"cmd": "test",
+                                   "model": args.model}) as owned_log:
+        res = optim.Evaluator(model, batch_size=args.batch_size).evaluate(
+            samples, methods)
+    if owned_log:
+        print(f"telemetry run log: {owned_log}")
     for r, m in res:
         print(f"{m}: {r}")
 
@@ -273,22 +279,32 @@ def cmd_perf(args) -> None:
             y = x.reshape(args.batch_size, -1)
         else:
             y = jnp.asarray(rng.integers(0, num_classes, args.batch_size))
-    step = TrainStep(model, criterion,
-                     optim.SGD(learning_rate=0.01, momentum=0.9),
-                     compute_dtype=jnp.bfloat16 if args.bf16 else None)
-    for i in range(args.warmup):
-        step.run(x, y, jax.random.key(i))
-    if args.warmup:
-        # drain the queue including the last warmup optimizer update
-        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
-    t0 = time.perf_counter()
-    for i in range(args.iteration):
-        step.run(x, y, jax.random.key(100 + i))
-    # params-derived fetch forces the LAST iteration's optimizer update
-    # inside the timed window (loss_i only depends on params_{i-1})
-    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
-    wall = time.perf_counter() - t0
-    rate = args.batch_size * args.iteration / wall
+    from bigdl_tpu import telemetry
+
+    with telemetry.maybe_run(meta={"cmd": "perf", "model": args.model,
+                                   "batch": args.batch_size}) as owned_log:
+        step = TrainStep(model, criterion,
+                         optim.SGD(learning_rate=0.01, momentum=0.9),
+                         compute_dtype=jnp.bfloat16 if args.bf16 else None)
+        with telemetry.span("perf/warmup", iters=args.warmup):
+            for i in range(args.warmup):
+                step.run(x, y, jax.random.key(i))
+            if args.warmup:
+                # drain the queue incl. the last warmup optimizer update
+                float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+        with telemetry.span("perf/timed", iters=args.iteration):
+            t0 = time.perf_counter()
+            for i in range(args.iteration):
+                step.run(x, y, jax.random.key(100 + i))
+            # params-derived fetch forces the LAST iteration's optimizer
+            # update inside the timed window (loss_i only depends on
+            # params_{i-1})
+            float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+            wall = time.perf_counter() - t0
+        rate = args.batch_size * args.iteration / wall
+        telemetry.counter("perf/records_per_sec", rate)
+    if owned_log:
+        print(f"telemetry run log: {owned_log}")
     print(f"{args.model}: {rate:.1f} records/sec "
           f"(batch {args.batch_size}, {args.iteration} iters, "
           f"{wall:.2f}s)")
@@ -312,6 +328,10 @@ def main(argv=None) -> None:
                         help="dataset folder (synthetic data when absent)")
         sp.add_argument("-b", "--batch-size", type=int, default=64)
         sp.add_argument("--num-classes", type=int, default=0)
+        sp.add_argument("--telemetry", default=None, metavar="DIR",
+                        help="write a JSONL telemetry run log under DIR "
+                             "(same as BIGDL_TELEMETRY; inspect with "
+                             "python -m bigdl_tpu.telemetry)")
 
     t = sub.add_parser("train", help="train a zoo model")
     common(t)
@@ -347,6 +367,10 @@ def main(argv=None) -> None:
     pf.set_defaults(fn=cmd_perf)
 
     args = p.parse_args(argv)
+    if getattr(args, "telemetry", None):
+        # the env route keeps one resolution path (utils/config.py);
+        # the Optimizer / perf harness start the run from config
+        os.environ["BIGDL_TELEMETRY"] = args.telemetry
     args.fn(args)
 
 

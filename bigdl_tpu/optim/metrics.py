@@ -11,7 +11,13 @@ the Optimizer loop into this accumulator and printed by ``summary()``.
 
 Deeper (op-level) timing comes from the profiler hook: set
 ``BIGDL_PROFILE=<dir>`` to capture a ``jax.profiler`` trace of the first
-few training iterations (``BIGDL_PROFILE_ITERS``, default 5)."""
+few training iterations (``BIGDL_PROFILE_ITERS``, default 5).
+
+When a telemetry run is active (``BIGDL_TELEMETRY``, see
+docs/observability.md) every recorded sample is ALSO forwarded to the
+event log as a ``stage`` event — the accumulator's call sites are the
+instrumentation points, so the timeline and the printed summary can
+never disagree about what was measured."""
 
 from __future__ import annotations
 
@@ -19,6 +25,8 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List
+
+from bigdl_tpu import telemetry
 
 __all__ = ["Metrics"]
 
@@ -31,10 +39,12 @@ class Metrics:
     def set(self, name: str, value: float):
         with self._lock:
             self._scalars[name] = [float(value)]
+        telemetry.gauge(name, value)
 
     def add(self, name: str, value: float):
         with self._lock:
             self._scalars.setdefault(name, []).append(float(value))
+        telemetry.stage(name, value)
 
     def get(self, name: str) -> float:
         """Mean of the recorded values (0.0 when empty)."""

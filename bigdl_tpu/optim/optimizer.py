@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet
 from bigdl_tpu.dataset.minibatch import MiniBatch
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
@@ -119,6 +120,10 @@ class _BatchPrefetcher:
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.5)
+                # producer-side fill level: a queue pinned at 0 means the
+                # input pipeline is the bottleneck; pinned at depth means
+                # the device is (docs/observability.md)
+                telemetry.gauge("prefetch/queue_depth", self._q.qsize())
                 return
             except queue.Full:
                 continue
@@ -350,6 +355,8 @@ class Optimizer:
                         log.info(f"[Checkpoint] pruned {p}")
                 log.info(f"[Checkpoint] saved sharded.{n} "
                          f"to {self._ckpt_dir}")
+                telemetry.instant("checkpoint/saved", step=n,
+                                  backend="sharded")
 
             if use_async:
                 self._ckpt_future = self._ckpt_pool_submit(tail)
@@ -383,6 +390,7 @@ class Optimizer:
                 self._prune_btpu()
             log.info(f"[Checkpoint] saved model.{n} / optimMethod.{n} "
                      f"to {self._ckpt_dir}")
+            telemetry.instant("checkpoint/saved", step=n, backend="btpu")
 
         if get_config().async_checkpoint:
             self._ckpt_future = self._ckpt_pool_submit(write)
@@ -498,6 +506,54 @@ class Optimizer:
                 sched.on_metric(val)
 
     # -- the loop ----------------------------------------------------------
+    def _telemetry_begin(self, cfg):
+        """Run-scoped telemetry wiring: auto-start a JSONL run when
+        ``BIGDL_TELEMETRY`` names a directory (owned = ended by us),
+        attach the retrace-attribution bridge to the dispatch hook bus,
+        and forward counter/gauge streams into the TrainSummary writers
+        so TensorBoard stays the visual frontend."""
+        self._tele_owner = False
+        self._tele_retrace = None
+        self._tele_summary_sink = None
+        try:
+            if cfg.telemetry_dir and not telemetry.enabled():
+                meta = {"model": type(self.model).__name__,
+                        "optimizer": type(self).__name__,
+                        "parameter_sync": self.parameter_sync}
+                telemetry.start_run(cfg.telemetry_dir, meta=meta)
+                self._tele_owner = True
+            tracer = telemetry.get()
+            if tracer is None:
+                return
+            from bigdl_tpu.telemetry.bridge import (RetraceBridge,
+                                                    SummaryBridge)
+
+            self._tele_retrace = RetraceBridge(tracer).install()
+            if self._train_summary is not None:
+                self._tele_summary_sink = SummaryBridge(self._train_summary)
+                tracer.add_sink(self._tele_summary_sink)
+        except Exception as e:  # noqa: BLE001 - observers never kill the run
+            log.warning(f"[Telemetry] disabled for this run "
+                        f"({type(e).__name__}: {e})")
+            try:
+                self._telemetry_end()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _telemetry_end(self):
+        tracer = telemetry.get()
+        if self._tele_retrace is not None:
+            self._tele_retrace.remove()
+            self._tele_retrace = None
+        if tracer is not None and self._tele_summary_sink is not None:
+            tracer.remove_sink(self._tele_summary_sink)
+            self._tele_summary_sink = None
+        if self._tele_owner:
+            telemetry.end_run()
+            self._tele_owner = False
+            log.info(f"[Telemetry] run log: {telemetry.last_run_path()} "
+                     f"(inspect: python -m bigdl_tpu.telemetry <log>)")
+
     def optimize(self):
         cfg = get_config()
         # two device clients on one chip deadlock in claim — detect the
@@ -508,21 +564,29 @@ class Optimizer:
         retry_window = cfg.failure_retry_interval
         failures: List[float] = []
         self._init_checkpoint_dir()
-        while True:
-            try:
-                return self._optimize_once()
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:  # noqa: BLE001 — retry loop parity
-                now = time.time()
-                failures = [t for t in failures if now - t < retry_window] + [now]
-                if len(failures) > retry_times:
-                    log.error(f"retry budget exhausted ({retry_times} in {retry_window}s)")
+        self._telemetry_begin(cfg)
+        try:
+            while True:
+                try:
+                    return self._optimize_once()
+                except KeyboardInterrupt:
                     raise
-                log.warning(f"training failed with {type(e).__name__}: {e}; "
-                            f"retry {len(failures)}/{retry_times}")
-                if not self._restore_latest():
-                    log.warning("no checkpoint to restore; restarting from current weights")
+                except Exception as e:  # noqa: BLE001 — retry loop parity
+                    now = time.time()
+                    failures = [t for t in failures if now - t < retry_window] + [now]
+                    telemetry.instant("run/retry", error=type(e).__name__,
+                                      message=str(e)[:200],
+                                      attempt=len(failures),
+                                      budget=retry_times)
+                    if len(failures) > retry_times:
+                        log.error(f"retry budget exhausted ({retry_times} in {retry_window}s)")
+                        raise
+                    log.warning(f"training failed with {type(e).__name__}: {e}; "
+                                f"retry {len(failures)}/{retry_times}")
+                    if not self._restore_latest():
+                        log.warning("no checkpoint to restore; restarting from current weights")
+        finally:
+            self._telemetry_end()
 
     def _optimize_once(self):
         mesh = self._mesh
@@ -585,20 +649,31 @@ class Optimizer:
 
         log.info(f"[Optimizer] start training to {mesh} "
                  f"(sync={self.parameter_sync}, compression={self.gradient_compression})")
+        tele = telemetry.get()
+        tele_base = tele.depth() if tele else 0
         try:
             while not self.end_when(self.state):
                 if profile_dir and not profiling and profile_iters > 0:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
                 t_start = time.perf_counter()
+                it_sid = tele.begin("train/iteration",
+                                    step=self.state["neval"] + 1) \
+                    if tele else None
+                dw_sid = tele.begin("data_wait") if tele else None
                 if prefetcher is not None:
                     item = prefetcher.next()
                     if item is None:
+                        if tele:
+                            tele.end(dw_sid)
+                            tele.end(it_sid)
                         break  # iterator exhausted (finite feeds)
                     batch_n, placed = item
                 else:
                     batch: MiniBatch = next(data_iter)
                     batch_n, placed = batch.size(), None
+                if tele:
+                    tele.end(dw_sid)
                 t_data = time.perf_counter()
                 key = jax.random.fold_in(key0, self.state["neval"])
 
@@ -649,6 +724,11 @@ class Optimizer:
                 self.metrics.add("data time", t_data - t_start)
                 self._iteration_times.append(t_end - t_data)
                 throughput = n / max(t_end - t_start, 1e-9)
+                if tele:
+                    tele.emit("step", step=self.state["neval"],
+                              dur=t_end - t_start, loss=loss, records=n,
+                              throughput=throughput,
+                              epoch=self.state["epoch"])
                 log.info(
                     f"[Epoch {self.state['epoch']} {records_this_epoch}/{dataset_size}]"
                     f"[Iteration {self.state['neval']}] Trained {n} records in "
@@ -665,6 +745,9 @@ class Optimizer:
                     self.state["_epoch_boundary"] = True
                     log.info(f"[Epoch {self.state['epoch'] - 1}] finished in "
                              f"{time.perf_counter() - epoch_start:.2f}s")
+                    if tele:
+                        tele.instant("epoch", epoch=self.state["epoch"] - 1,
+                                     dur=time.perf_counter() - epoch_start)
                     epoch_start = time.perf_counter()
                 if self._train_summary is not None:
                     ts = self._train_summary
@@ -687,13 +770,23 @@ class Optimizer:
                             ts.add_histogram(pname, np.asarray(arr),
                                              self.state["neval"])
                 if self._val_trigger is not None and self._val_trigger(self.state):
-                    with self.metrics.timer("validation time"):
+                    with self.metrics.timer("validation time"), \
+                            telemetry.span("validation"):
                         step.sync_to_model()
                         self._validate(eval_step)
                 if self._ckpt_trigger is not None and self._ckpt_trigger(self.state):
-                    with self.metrics.timer("checkpoint time"):
+                    with self.metrics.timer("checkpoint time"), \
+                            telemetry.span("checkpoint"):
                         self._save_checkpoint(step)
+                if tele:
+                    tele.end(it_sid)
         except BaseException:
+            if tele:
+                # close the spans the exception left open in THIS scope
+                # (marked abandoned) — begin/end pairing is an invariant
+                # of the log, not of the happy path; spans the CALLER
+                # opened around optimize() stay theirs to close
+                tele.unwind(to_depth=tele_base)
             # the compiled step DONATES param/opt buffers, so the module
             # tree's original arrays are already deleted after the first
             # iteration — write the last-completed-iteration params back
@@ -757,7 +850,11 @@ class Optimizer:
         except queue.Empty:
             # the dispatch thread stays blocked on the device; recovery
             # re-initializes from the last checkpoint (the only safe move
-            # on a synchronous SPMD step — see docs/straggler.md)
+            # on a synchronous SPMD step — see docs/straggler.md).  The
+            # firing lands in the telemetry timeline alongside the steps
+            # it interrupted, not just in the logger stream.
+            telemetry.instant("straggler/timeout", budget_s=timeout,
+                              step=self.state["neval"] + 1)
             raise StragglerTimeout(
                 f"iteration exceeded the straggler budget of {timeout:.1f}s "
                 f"(BIGDL_ITERATION_TIMEOUT)") from None

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import telemetry as _telemetry
 from bigdl_tpu.analysis import hooks as _hooks
 from bigdl_tpu.nn.module import Module, functional_call, state_dict, _resolve
 from bigdl_tpu.parallel.mesh import (DATA_AXIS, data_sharding,
@@ -47,6 +48,29 @@ def _jit_cache_size(compiled) -> Optional[int]:
         return int(compiled._cache_size())
     except Exception:  # noqa: BLE001 - observability only, never fail
         return None
+
+
+def _note_compile(tracer, owner, kind: str, before, t0: float,
+                  compiled) -> bool:
+    """Post-dispatch compile detection for the telemetry stream: the jit
+    executable cache grew (or this is the owner's first dispatch and the
+    cache size is unreadable) means the call just paid trace+compile —
+    emit it with the wall time of the dispatch that carried it.  Returns
+    whether a compile was recorded (the caller keys one-time facts off
+    the first)."""
+    after = _jit_cache_size(compiled)
+    first = not getattr(owner, "_tele_dispatched", False)
+    owner._tele_dispatched = True
+    if before is not None and after is not None:
+        grew = after > before
+    else:
+        grew = first
+    if grew:
+        fields = {"dur": time.perf_counter() - t0}
+        if after is not None:
+            fields["cache_size"] = after
+        tracer.emit("compile", name=kind, **fields)
+    return first
 
 __all__ = ["TrainStep", "bf16_truncate", "EvalStep"]
 
@@ -324,13 +348,13 @@ class TrainStep:
         Single-host callers pass the GLOBAL batch; multi-host callers pass
         this process's LOCAL shard of it (per-process data sharding, the
         reference's per-node partition feeding)."""
-        active = _hooks.hooks_active()
-        if active:  # retrace detector sees the RAW args
+        if _hooks.hooks_active():  # retrace detector sees the RAW args
             _hooks.dispatch_event(self, "TrainStep.run",
                                   {"x": x, "y": y, "key": key})
         x, y = self._shard_batch(x, y)
-        if active:  # set only once run_sharded is definitely next
-            self._dispatch_observed = "TrainStep.run"
+        # set only once run_sharded is definitely next — names both the
+        # hooks cache event and the telemetry compile event after it
+        self._dispatch_observed = "TrainStep.run"
         return self.run_sharded(x, y, key)
 
     def run_sharded(self, x, y, key):
@@ -351,12 +375,43 @@ class TrainStep:
         self._dispatch_observed = None
         if self._compiled is None:
             self._compiled = self._build()
+        tracer = _telemetry.get()
+        before = _jit_cache_size(self._compiled) if tracer else None
+        t0 = time.perf_counter()
         self.params, self.opt_state, self.buffers, loss = self._compiled(
             self.params, self.opt_state, self.buffers, x, y, key)
+        if tracer is not None:
+            first = _note_compile(tracer, self, kind, before,
+                                  t0, self._compiled)
+            if first:
+                self._emit_device_facts(tracer, x, y, key)
         if _hooks.hooks_active():
             _hooks.cache_event(self, kind,
                                _jit_cache_size(self._compiled))
         return loss
+
+    def _emit_device_facts(self, tracer, x, y, key) -> None:
+        """Once per step object: pull the compiled program's cost/memory
+        story (telemetry/device.py) so throughput numbers in the log come
+        with an explanation.  ``auto`` re-lowers the already-traced step
+        (no XLA compile); ``full`` additionally AOT-compiles for the HBM
+        breakdown; ``off`` skips."""
+        from bigdl_tpu.telemetry import device as _tdev
+        from bigdl_tpu.utils.config import get_config
+
+        level = get_config().telemetry_device
+        if level == "off":
+            return
+        try:
+            lowered = self._compiled.lower(
+                self.params, self.opt_state, self.buffers, x, y, key)
+            facts = _tdev.collect_device_facts(
+                lowered, (self.params, self.opt_state, self.buffers),
+                level=level)
+        except Exception:  # noqa: BLE001 - facts must never fail the step
+            return
+        if facts:
+            tracer.emit("device_facts", facts=facts)
 
     def _shard_batch(self, x, y, stacked: bool = False):
         if self.mesh is None:
@@ -422,9 +477,27 @@ class TrainStep:
         for ``run_scan`` and returns its XLA cost analysis (the scan BODY
         is counted once — multiply flops by n for totals)."""
         x, y = self._shard_batch(x, y, stacked)
-        compiled = self._build_scan(n, stacked).lower(
-            self.params, self.opt_state, self.buffers, x, y, key).compile()
+        tracer = _telemetry.get()
+        t0 = time.perf_counter()
+        lowered = self._build_scan(n, stacked).lower(
+            self.params, self.opt_state, self.buffers, x, y, key)
+        compiled = lowered.compile()
         self._scan_cache = ((n, stacked), compiled)
+        if tracer is not None:
+            tracer.emit("compile", name="TrainStep.aot_scan",
+                        dur=time.perf_counter() - t0, iters=n)
+            from bigdl_tpu.telemetry import device as _tdev
+            from bigdl_tpu.utils.config import get_config
+
+            if get_config().telemetry_device != "off":
+                # the executable is in hand: the HBM breakdown is free
+                # here ("auto" suffices — "full" would only re-compile)
+                facts = _tdev.collect_device_facts(
+                    lowered, (self.params, self.opt_state, self.buffers),
+                    level="auto")
+                facts.update(_tdev.memory_facts(compiled))
+                if facts:
+                    tracer.emit("device_facts", facts=facts)
         return compiled.cost_analysis()
 
     def gather_replicated(self, tree):
@@ -487,7 +560,13 @@ class EvalStep:
                     jnp.asarray(a), data_sharding(self.mesh, np.ndim(a), self.batch_axes)), x)
         else:
             x = jax.tree.map(jnp.asarray, x)
+        tracer = _telemetry.get()
+        before = _jit_cache_size(self._compiled) if tracer else None
+        t0 = time.perf_counter()
         out = self._compiled(state, x)
+        if tracer is not None:
+            _note_compile(tracer, self, "EvalStep.run", before, t0,
+                          self._compiled)
         if _hooks.hooks_active():
             _hooks.cache_event(self, "EvalStep.run",
                                _jit_cache_size(self._compiled))

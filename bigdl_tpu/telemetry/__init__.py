@@ -1,0 +1,184 @@
+"""Unified runtime telemetry (docs/observability.md).
+
+One process-wide event stream that every hot layer emits into — the
+Optimizer loop stages, TrainStep/EvalStep compile + retrace events,
+dataset prefetch depth, checkpointing, the straggler watchdog — persisted
+as an append-only JSONL log per run and summarized by
+``python -m bigdl_tpu.telemetry <run.jsonl>`` (per-stage table, step
+percentiles, compile/retrace timeline, MFU, Chrome trace export).
+
+Enable with ``BIGDL_TELEMETRY=<dir>`` (the Optimizer starts/ends the run
+around ``optimize()``), or programmatically::
+
+    from bigdl_tpu import telemetry
+    with telemetry.run("/tmp/tele", meta={"job": "resnet"}):
+        optimizer.optimize()
+
+The module-level helpers (``span``/``stage``/``counter``/``gauge``/
+``instant``) are no-ops costing one falsy check when no run is active,
+so instrumented code needs no gating of its own.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Any, Dict, Optional
+
+from bigdl_tpu.telemetry.tracer import (SCHEMA_VERSION, JsonlSink,
+                                        MemorySink, Tracer)
+
+__all__ = ["SCHEMA_VERSION", "Tracer", "JsonlSink", "MemorySink",
+           "enabled", "get", "start_run", "end_run", "run", "maybe_run",
+           "last_run_path", "span", "stage", "counter", "gauge",
+           "instant", "emit"]
+
+_active: Optional[Tracer] = None
+_last_run_path: Optional[str] = None
+_lifecycle_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """True when a run is active — the one-check fast path."""
+    return _active is not None
+
+
+def get() -> Optional[Tracer]:
+    """The active tracer, or None.  Hot loops fetch it once per run and
+    branch on the local."""
+    return _active
+
+
+def last_run_path() -> Optional[str]:
+    """Path of the most recent JSONL run log (survives ``end_run`` so a
+    CLI can point the user at the artifact it just produced)."""
+    return _last_run_path
+
+
+def _default_meta() -> Dict[str, Any]:
+    meta: Dict[str, Any] = {"schema": SCHEMA_VERSION}
+    try:  # device facts are best-effort: telemetry must work sans jax
+        import jax
+
+        dev = jax.devices()[0]
+        meta.update(device_kind=dev.device_kind,
+                    device_count=jax.device_count(),
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count())
+    except Exception:  # noqa: BLE001 - meta only
+        pass
+    return meta
+
+
+def start_run(path_or_dir: Optional[str] = None,
+              meta: Optional[Dict[str, Any]] = None,
+              sinks=None) -> Tracer:
+    """Install the process-wide tracer.  ``path_or_dir``: a ``.jsonl``
+    path is used as-is; a directory gets a fresh
+    ``run-<stamp>-<pid>.jsonl``; None writes to no file (pass ``sinks``,
+    e.g. a MemorySink, instead).  Raises if a run is already active —
+    nested runs would interleave two schedules into one file."""
+    global _active, _last_run_path
+    with _lifecycle_lock:
+        if _active is not None:
+            raise RuntimeError("a telemetry run is already active; "
+                               "end_run() it first")
+        all_sinks = list(sinks or [])
+        if path_or_dir is not None:
+            path = path_or_dir
+            if not path.endswith(".jsonl"):
+                stamp = time.strftime("%Y%m%d_%H%M%S")
+                path = os.path.join(path_or_dir,
+                                    f"run-{stamp}-{os.getpid()}.jsonl")
+            all_sinks.append(JsonlSink(path))
+            _last_run_path = path
+        full_meta = _default_meta()
+        full_meta.update(meta or {})
+        tracer = Tracer(sinks=all_sinks, meta=full_meta)
+        tracer.start()
+        _active = tracer
+        return tracer
+
+
+def end_run() -> None:
+    """Close the active run (flushes and closes sinks); no-op when no
+    run is active."""
+    global _active
+    with _lifecycle_lock:
+        tracer, _active = _active, None
+    if tracer is not None:
+        tracer.close()
+
+
+@contextmanager
+def run(path_or_dir: Optional[str] = None,
+        meta: Optional[Dict[str, Any]] = None, sinks=None):
+    tracer = start_run(path_or_dir, meta=meta, sinks=sinks)
+    try:
+        yield tracer
+    finally:
+        end_run()
+
+
+@contextmanager
+def maybe_run(meta: Optional[Dict[str, Any]] = None):
+    """Config-gated run ownership for entry points (bench.py,
+    profile_bench, models/cli perf): start a JSONL run when
+    ``BIGDL_TELEMETRY`` names a directory and no run is active yet.
+    Yields the owned run-log path, or None when telemetry is off or an
+    OUTER scope owns the stream — in which case that run is left
+    untouched.  The owned run is ended on every exit path, so an
+    exception inside the block can never leak the process-wide tracer
+    or an unflushed log."""
+    from bigdl_tpu.utils.config import get_config
+
+    if not get_config().telemetry_dir or enabled():
+        yield None
+        return
+    start_run(get_config().telemetry_dir, meta=meta)
+    try:
+        yield _last_run_path
+    finally:
+        end_run()
+
+
+# -- no-op-when-disabled emit helpers ---------------------------------------
+def span(name: str, **attrs):
+    """Context manager timing a with-block as a span (nullcontext when
+    disabled)."""
+    tracer = _active
+    if tracer is None:
+        return nullcontext()
+    return tracer.span(name, **attrs)
+
+
+def stage(name: str, dur: float, **attrs) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.stage(name, dur, **attrs)
+
+
+def counter(name: str, value: float, **attrs) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.counter(name, value, **attrs)
+
+
+def gauge(name: str, value: float, **attrs) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.gauge(name, value, **attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.instant(name, **attrs)
+
+
+def emit(kind: str, **fields) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.emit(kind, **fields)

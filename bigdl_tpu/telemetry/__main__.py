@@ -1,0 +1,68 @@
+"""CLI: ``python -m bigdl_tpu.telemetry <run.jsonl>`` — inspect a run.
+
+Default output: the summary report (per-stage time table, step-time
+p50/p95, compile/retrace/event timeline, device facts + MFU estimate).
+
+Options::
+
+    python -m bigdl_tpu.telemetry run.jsonl                  # summary
+    python -m bigdl_tpu.telemetry run.jsonl --json           # machine view
+    python -m bigdl_tpu.telemetry run.jsonl --chrome t.json  # chrome://tracing
+    python -m bigdl_tpu.telemetry run.jsonl --validate       # schema check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from bigdl_tpu.telemetry import schema
+from bigdl_tpu.telemetry.chrome_trace import write_chrome_trace
+from bigdl_tpu.telemetry.report import format_summary, summarize
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bigdl_tpu.telemetry",
+        description="summarize / export a telemetry run log")
+    p.add_argument("run", help="path to a run-*.jsonl event log")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as JSON instead of text")
+    p.add_argument("--chrome", metavar="OUT.json", default=None,
+                   help="also write a Chrome trace_event JSON for "
+                        "chrome://tracing / Perfetto")
+    p.add_argument("--validate", action="store_true",
+                   help="only validate the log against the schema; "
+                        "exit 1 on any violation")
+    args = p.parse_args(argv)
+
+    events, parse_errors = schema.read_events(args.run)
+    if args.validate:
+        errors = parse_errors + schema.validate_events(events)
+        if errors:
+            for e in errors:
+                print(e, file=sys.stderr)
+            print(f"{len(events)} events, {len(errors)} problems")
+            return 1
+        print(f"{len(events)} events, schema ok")
+        return 0
+
+    for e in parse_errors:  # non-fatal: a crashed run truncates a line
+        print(f"warning: {e}", file=sys.stderr)
+
+    summary = summarize(events)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(format_summary(summary, events))
+    if args.chrome:
+        n = write_chrome_trace(events, args.chrome)
+        print(f"\nchrome trace: {args.chrome} ({n} trace events) — open "
+              f"in chrome://tracing or https://ui.perfetto.dev",
+              file=sys.stderr if args.json else sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Bridges between the telemetry stream and the existing observers.
+
+``RetraceBridge`` — wires the static analyzer's retrace detector
+(``analysis/retrace.py``) onto the dispatch hook bus and re-emits every
+diagnostic as a ``retrace`` event, so which-argument-retraced-what lands
+in the same timeline as the compile it caused.
+
+``SummaryBridge`` — a tracer *sink* that forwards counter/gauge samples
+into a ``TrainSummary``/``ValidationSummary`` writer as
+``telemetry/<name>`` scalars, keeping TensorBoard the visual frontend
+without a second instrumentation path.  The scalar step is the latest
+training step seen in the stream (0 before the first step event).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["RetraceBridge", "SummaryBridge"]
+
+
+class RetraceBridge:
+    """hooks-bus monitor: retrace diagnostics -> telemetry events."""
+
+    def __init__(self, tracer):
+        from bigdl_tpu.analysis.retrace import RetraceMonitor
+
+        self._tracer = tracer
+        self._monitor = RetraceMonitor()
+        self._emitted = 0
+        self._installed = False
+
+    # the hooks bus calls these (analysis/hooks.py contract)
+    def on_dispatch(self, owner, kind: str, args) -> None:
+        self._monitor.on_dispatch(owner, kind, args)
+        self._drain()
+
+    def on_cache(self, owner, kind: str, size) -> None:
+        self._monitor.on_cache(owner, kind, size)
+        self._drain()
+
+    def _drain(self) -> None:
+        diags = self._monitor.report.diagnostics
+        for d in diags[self._emitted:]:
+            self._tracer.emit("retrace", rule=d.rule, message=d.message,
+                              where=d.where, hint=d.hint)
+        self._emitted = len(diags)
+
+    def install(self) -> "RetraceBridge":
+        from bigdl_tpu.analysis import hooks
+
+        if not self._installed:
+            hooks.register(self)
+            self._installed = True
+        return self
+
+    def remove(self) -> None:
+        from bigdl_tpu.analysis import hooks
+
+        if self._installed:
+            hooks.unregister(self)
+            self._installed = False
+
+
+class SummaryBridge:
+    """Tracer sink: counter/gauge events -> TensorBoard scalars."""
+
+    def __init__(self, summary, prefix: str = "telemetry/"):
+        self._summary = summary
+        self._prefix = prefix
+        self._step = 0
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        kind = event.get("kind")
+        if kind == "step" and isinstance(event.get("step"), int):
+            self._step = event["step"]
+        elif kind in ("counter", "gauge"):
+            self._summary.add_scalar(
+                self._prefix + str(event.get("name", "?")),
+                float(event.get("value", 0.0)), self._step)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        # the summary writer is owned by whoever created it (the user /
+        # the Optimizer), not by the tracer — never close it here
+        pass

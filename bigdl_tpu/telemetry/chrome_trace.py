@@ -1,0 +1,94 @@
+"""Chrome ``trace_event`` export: render a telemetry run for
+chrome://tracing / Perfetto.
+
+Mapping (one lane per pid/tid, as the tracer emitted them):
+
+- ``span_begin``/``span_end`` -> duration events (``ph: B``/``E``) —
+  the pairs are LIFO per thread by construction (schema.validate_run
+  asserts it), which is exactly Chrome's nesting contract;
+- ``stage`` -> complete events (``ph: X``) ending at their emission ts
+  (a stage sample records a duration after the fact);
+- ``step`` -> complete events named ``step <n>`` carrying loss /
+  records / throughput in args;
+- ``compile`` -> complete events on their thread;
+- ``counter``/``gauge`` -> counter tracks (``ph: C``);
+- ``event``/``retrace`` -> instant events (``ph: i``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List
+
+__all__ = ["chrome_trace", "write_chrome_trace"]
+
+_BASE_FIELDS = ("v", "ts", "pid", "tid", "kind", "name", "span",
+                "parent", "depth", "dur", "value", "step", "meta",
+                "facts", "rule", "message")
+
+
+def _args(event: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in event.items() if k not in _BASE_FIELDS}
+
+
+def _us(ts: float) -> float:
+    return ts * 1e6
+
+
+def chrome_trace(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Build the ``{"traceEvents": [...]}`` object from parsed run
+    events."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        kind = ev.get("kind")
+        pid, tid, ts = ev.get("pid", 0), ev.get("tid", 0), ev.get("ts", 0.0)
+        if kind == "span_begin":
+            out.append({"ph": "B", "name": ev.get("name", "?"),
+                        "pid": pid, "tid": tid, "ts": _us(ts),
+                        "args": _args(ev)})
+        elif kind == "span_end":
+            out.append({"ph": "E", "name": ev.get("name", "?"),
+                        "pid": pid, "tid": tid, "ts": _us(ts),
+                        "args": _args(ev)})
+        elif kind in ("stage", "compile"):
+            dur = float(ev.get("dur", 0.0))
+            out.append({"ph": "X", "name": ev.get("name", "?"),
+                        "cat": kind, "pid": pid, "tid": tid,
+                        "ts": _us(ts - dur), "dur": _us(dur),
+                        "args": _args(ev)})
+        elif kind == "step":
+            dur = float(ev.get("dur", 0.0))
+            args = _args(ev)
+            for key in ("loss", "records", "throughput"):
+                if key in ev:
+                    args[key] = ev[key]
+            out.append({"ph": "X", "name": f"step {ev.get('step', '?')}",
+                        "cat": "step", "pid": pid, "tid": tid,
+                        "ts": _us(ts - dur), "dur": _us(dur),
+                        "args": args})
+        elif kind in ("counter", "gauge"):
+            name = ev.get("name", "?")
+            out.append({"ph": "C", "name": name, "pid": pid, "tid": tid,
+                        "ts": _us(ts),
+                        "args": {name: ev.get("value", 0.0)}})
+        elif kind in ("event", "retrace"):
+            name = ev.get("name") or ev.get("rule", "?")
+            args = _args(ev)
+            if kind == "retrace":
+                args["message"] = ev.get("message", "")
+            out.append({"ph": "i", "name": name, "cat": kind, "pid": pid,
+                        "tid": tid, "ts": _us(ts), "s": "t",
+                        "args": args})
+        elif kind == "run_start":
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "tid": tid, "ts": _us(ts),
+                        "args": {"name": "bigdl_tpu run"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(events: Iterable[Dict[str, Any]], path: str) -> int:
+    """Write the Chrome JSON; returns the number of trace events."""
+    trace = chrome_trace(events)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    return len(trace["traceEvents"])

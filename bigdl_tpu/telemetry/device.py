@@ -1,0 +1,182 @@
+"""Device-side facts for the telemetry stream: XLA cost analysis
+(flops/bytes -> MFU denominators), compiled-executable memory analysis
+(HBM breakdown, donated-buffer aliasing), and live device memory.
+
+Levels (``BIGDL_TELEMETRY_DEVICE``):
+
+- ``off``  — emit nothing;
+- ``auto`` (default) — everything that costs at most a re-lower of the
+  already-traced program: ``Lowered.cost_analysis()`` flops/bytes,
+  host-computed donated-buffer bytes, ``device.memory_stats()``;
+- ``full`` — additionally AOT-compiles the lowered program to read
+  ``Compiled.memory_analysis()`` (argument/output/temp/alias bytes —
+  the HBM breakdown).  NOTE: JAX's AOT compile does NOT share the jit
+  dispatch cache, so ``full`` pays one extra XLA compile per step
+  object; it is for diagnosis sessions, not always-on production runs.
+
+MFU is *not* computed here — the log carries ``flops_per_step`` +
+``peak_flops_per_device`` + ``device_count`` and the CLI divides by the
+measured step time, so the estimate stays recomputable from the
+artifact alone.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["peak_flops_per_device", "cost_facts", "memory_facts",
+           "live_memory_facts", "donated_bytes", "collect_device_facts",
+           "mfu_estimate"]
+
+#: per-chip dense bf16 peak FLOP/s by device_kind prefix (the bench.py
+#: table's sibling — shared convention: BIGDL_PEAK_FLOPS overrides).
+_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4 lite": 137e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_device(device_kind: str) -> Optional[float]:
+    """Dense bf16 peak FLOP/s for one device, or None when unknown (CPU
+    has no meaningful MFU denominator).  ``BIGDL_PEAK_FLOPS`` (FLOP/s)
+    overrides the table — also the escape hatch for new TPU kinds."""
+    env = os.environ.get("BIGDL_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = (device_kind or "").lower()
+    best = None
+    for name, peak in _PEAK_FLOPS.items():
+        if kind.startswith(name.lower()):
+            # longest prefix wins ("TPU v5 lite" over "TPU v5")
+            if best is None or len(name) > best[0]:
+                best = (len(name), peak)
+    return best[1] if best else None
+
+
+def cost_facts(lowered) -> Dict[str, Any]:
+    """flops / bytes accessed from a ``jax.stages.Lowered`` (HLO-level
+    cost analysis — no XLA compile)."""
+    out: Dict[str, Any] = {}
+    try:
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost.get("flops"):
+            out["flops_per_step"] = float(cost["flops"])
+        if cost.get("bytes accessed"):
+            out["bytes_accessed"] = float(cost["bytes accessed"])
+    except Exception:  # noqa: BLE001 - facts are best-effort
+        pass
+    return out
+
+
+def memory_facts(compiled) -> Dict[str, Any]:
+    """HBM breakdown from ``Compiled.memory_analysis()`` (argument /
+    output / temp / generated-code / donation-alias bytes)."""
+    out: Dict[str, Any] = {}
+    try:
+        ma = compiled.memory_analysis()
+        for key, attr in (("argument_bytes", "argument_size_in_bytes"),
+                          ("output_bytes", "output_size_in_bytes"),
+                          ("temp_bytes", "temp_size_in_bytes"),
+                          ("code_bytes", "generated_code_size_in_bytes"),
+                          ("alias_bytes", "alias_size_in_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[key] = int(v)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def live_memory_facts(device=None) -> Dict[str, Any]:
+    """Live allocator stats of one device (``bytes_in_use`` /
+    ``bytes_limit`` / ``peak_bytes_in_use`` where the backend reports
+    them; CPU reports nothing)."""
+    out: Dict[str, Any] = {}
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats:
+            for key in ("bytes_in_use", "bytes_limit",
+                        "peak_bytes_in_use", "largest_alloc_size"):
+                if key in stats:
+                    out[key] = int(stats[key])
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def donated_bytes(*trees) -> int:
+    """Host-side accounting of the donated argument trees (params /
+    opt_state / buffers): the bytes the step re-uses in place instead of
+    double-buffering."""
+    total = 0
+    try:
+        import jax
+
+        for tree in trees:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                nbytes = getattr(leaf, "nbytes", None)
+                if nbytes is None:
+                    size = getattr(leaf, "size", 0)
+                    itemsize = getattr(getattr(leaf, "dtype", None),
+                                       "itemsize", 0)
+                    nbytes = size * itemsize
+                total += int(nbytes)
+    except Exception:  # noqa: BLE001
+        pass
+    return total
+
+
+def collect_device_facts(lowered, donated_trees=(), level: str = "auto"
+                         ) -> Dict[str, Any]:
+    """Assemble one ``device_facts`` payload from a lowered step (see
+    module docstring for what each level costs)."""
+    if level == "off":
+        return {}
+    facts = cost_facts(lowered)
+    db = donated_bytes(*donated_trees)
+    if db:
+        facts["donated_bytes"] = db
+    facts.update(live_memory_facts())
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        facts["device_kind"] = dev.device_kind
+        facts["device_count"] = jax.device_count()
+        peak = peak_flops_per_device(dev.device_kind)
+        if peak:
+            facts["peak_flops_per_device"] = peak
+    except Exception:  # noqa: BLE001
+        pass
+    if level == "full":
+        try:
+            facts.update(memory_facts(lowered.compile()))
+        except Exception:  # noqa: BLE001
+            pass
+    return facts
+
+
+def mfu_estimate(flops_per_step: float, step_seconds: float,
+                 peak_flops_per_dev: float, device_count: int = 1
+                 ) -> Optional[float]:
+    """Model FLOP utilization: achieved FLOP/s over the fleet peak.
+    ``flops_per_step`` counts the GLOBAL step (XLA cost analysis of the
+    SPMD program), so the denominator scales by device count."""
+    if not (flops_per_step and step_seconds and peak_flops_per_dev):
+        return None
+    denom = peak_flops_per_dev * max(device_count, 1)
+    return (flops_per_step / step_seconds) / denom

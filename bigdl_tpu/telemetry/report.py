@@ -1,0 +1,235 @@
+"""Run summarization: turn one JSONL event log back into the questions
+an operator asks — where did the time go (per-stage table), how stable
+were the steps (p50/p95), what compiled or retraced when, and how close
+to the hardware did the run get (MFU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from bigdl_tpu.telemetry.device import mfu_estimate
+
+__all__ = ["summarize", "format_summary"]
+
+
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (numpy-free so the reader stays light)."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate parsed events into one summary dict (the CLI's text and
+    ``--json`` views are both renderings of it)."""
+    meta: Dict[str, Any] = {}
+    stages: Dict[str, Dict[str, float]] = {}
+    steps: List[Dict[str, Any]] = []
+    compiles: List[Dict[str, Any]] = []
+    retraces: List[Dict[str, Any]] = []
+    instants: List[Dict[str, Any]] = []
+    counters: Dict[str, Dict[str, float]] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+    facts: Dict[str, Any] = {}
+    t0 = t1 = None
+
+    def _stage_sample(name: str, dur: float) -> None:
+        row = stages.setdefault(name, {"n": 0, "total_s": 0.0})
+        row["n"] += 1
+        row["total_s"] += dur
+
+    for ev in events:
+        kind = ev.get("kind")
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            t0 = ts if t0 is None else min(t0, ts)
+            t1 = ts if t1 is None else max(t1, ts)
+        if kind == "run_start":
+            meta.update(ev.get("meta") or {})
+        elif kind == "stage":
+            _stage_sample(ev.get("name", "?"), float(ev.get("dur", 0.0)))
+        elif kind == "span_end":
+            _stage_sample(ev.get("name", "?"), float(ev.get("dur", 0.0)))
+        elif kind == "step":
+            steps.append(ev)
+        elif kind == "compile":
+            compiles.append(ev)
+        elif kind == "retrace":
+            retraces.append(ev)
+        elif kind == "event":
+            instants.append(ev)
+        elif kind == "counter":
+            row = counters.setdefault(ev.get("name", "?"),
+                                      {"n": 0, "total": 0.0, "last": 0.0})
+            row["n"] += 1
+            row["total"] += float(ev.get("value", 0.0))
+            row["last"] = float(ev.get("value", 0.0))
+        elif kind == "gauge":
+            v = float(ev.get("value", 0.0))
+            row = gauges.setdefault(ev.get("name", "?"),
+                                    {"n": 0, "min": v, "max": v,
+                                     "last": v})
+            row["n"] += 1
+            row["min"] = min(row["min"], v)
+            row["max"] = max(row["max"], v)
+            row["last"] = v
+        elif kind == "device_facts":
+            facts.update(ev.get("facts") or {})
+
+    for row in stages.values():
+        row["mean_s"] = row["total_s"] / row["n"] if row["n"] else 0.0
+
+    durs = [float(s.get("dur", 0.0)) for s in steps]
+    # the first step carries XLA compile — percentiles describe the
+    # steady state, so it is excluded when there is a steady state
+    steady = durs[1:] if len(durs) > 1 else durs
+    records = sum(int(s.get("records", 0)) for s in steps)
+    step_stats: Dict[str, Any] = {
+        "count": len(steps),
+        "records": records,
+        "total_s": sum(durs),
+        "p50_s": _percentile(steady, 50),
+        "p95_s": _percentile(steady, 95),
+        "mean_s": (sum(steady) / len(steady)) if steady else 0.0,
+    }
+    if steps and records:
+        tp = [float(s["throughput"]) for s in steps if "throughput" in s]
+        if tp:
+            step_stats["throughput_mean"] = sum(tp) / len(tp)
+
+    mfu = None
+    if facts.get("flops_per_step") and facts.get("peak_flops_per_device") \
+            and step_stats["p50_s"]:
+        mfu = mfu_estimate(facts["flops_per_step"], step_stats["p50_s"],
+                           facts["peak_flops_per_device"],
+                           int(facts.get("device_count", 1)))
+
+    return {"meta": meta,
+            "wall_s": (t1 - t0) if (t0 is not None and t1 is not None)
+            else 0.0,
+            "stages": stages, "steps": step_stats,
+            "compiles": compiles, "retraces": retraces,
+            "events": instants, "counters": counters, "gauges": gauges,
+            "device_facts": facts, "mfu": mfu}
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def _rel(ev: Dict[str, Any], t0: Optional[float]) -> str:
+    ts = ev.get("ts")
+    if t0 is None or not isinstance(ts, (int, float)):
+        return "      ?"
+    return f"{ts - t0:7.2f}"
+
+
+def format_summary(summary: Dict[str, Any],
+                   events: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Human-readable report (the CLI's default output)."""
+    lines: List[str] = []
+    meta = summary["meta"]
+    head = ["== telemetry run =="]
+    for key in ("device_kind", "device_count", "process_count", "model",
+                "parameter_sync"):
+        if key in meta:
+            head.append(f"{key}={meta[key]}")
+    lines.append("  ".join(head))
+    lines.append(f"wall {summary['wall_s']:.2f}s")
+
+    st = summary["steps"]
+    if st["count"]:
+        lines.append("")
+        lines.append(f"steps: {st['count']} ({st['records']} records)  "
+                     f"p50 {st['p50_s']*1e3:.2f} ms  "
+                     f"p95 {st['p95_s']*1e3:.2f} ms  "
+                     f"mean {st['mean_s']*1e3:.2f} ms")
+        if "throughput_mean" in st:
+            lines.append(f"throughput: {st['throughput_mean']:.1f} "
+                         f"records/s (mean)")
+
+    if summary["stages"]:
+        lines.append("")
+        lines.append("-- stage time --")
+        width = max(len(n) for n in summary["stages"])
+        order = sorted(summary["stages"].items(),
+                       key=lambda kv: -kv[1]["total_s"])
+        for name, row in order:
+            lines.append(f"{name:<{width}}  total {row['total_s']:9.4f} s"
+                         f"  mean {row['mean_s']*1e3:9.3f} ms"
+                         f"  n={int(row['n'])}")
+
+    t0 = None
+    if events:
+        tss = [e["ts"] for e in events
+               if isinstance(e.get("ts"), (int, float))]
+        t0 = min(tss) if tss else None
+    timeline = [("compile", c) for c in summary["compiles"]]
+    timeline += [("retrace", r) for r in summary["retraces"]]
+    timeline += [("event", e) for e in summary["events"]]
+    timeline.sort(key=lambda kv: kv[1].get("ts", 0.0))
+    if timeline:
+        lines.append("")
+        lines.append("-- compile / retrace / event timeline (t+s) --")
+        for tag, ev in timeline:
+            if tag == "compile":
+                lines.append(f"{_rel(ev, t0)}  compile  "
+                             f"{ev.get('name', '?')}  "
+                             f"{float(ev.get('dur', 0.0)):.3f}s")
+            elif tag == "retrace":
+                lines.append(f"{_rel(ev, t0)}  retrace  "
+                             f"{ev.get('rule', '?')}  "
+                             f"{ev.get('where', '')}: "
+                             f"{ev.get('message', '')}")
+            else:
+                extra = ev.get("error") or ev.get("budget_s") or ""
+                lines.append(f"{_rel(ev, t0)}  event    "
+                             f"{ev.get('name', '?')}"
+                             f"{('  ' + str(extra)) if extra else ''}")
+
+    facts = summary["device_facts"]
+    if facts:
+        lines.append("")
+        lines.append("-- device facts --")
+        if "flops_per_step" in facts:
+            lines.append(f"flops/step        "
+                         f"{facts['flops_per_step']/1e9:.2f} GF")
+        if "bytes_accessed" in facts:
+            lines.append(f"bytes accessed    "
+                         f"{_fmt_bytes(facts['bytes_accessed'])}")
+        for key, label in (("donated_bytes", "donated buffers"),
+                           ("argument_bytes", "hbm arguments"),
+                           ("output_bytes", "hbm outputs"),
+                           ("temp_bytes", "hbm temporaries"),
+                           ("alias_bytes", "hbm donated-alias"),
+                           ("code_bytes", "hbm program"),
+                           ("bytes_in_use", "hbm live"),
+                           ("peak_bytes_in_use", "hbm live peak"),
+                           ("bytes_limit", "hbm capacity")):
+            if key in facts:
+                lines.append(f"{label:<17} {_fmt_bytes(facts[key])}")
+        if summary["mfu"] is not None:
+            lines.append(f"MFU (p50 step)    {summary['mfu']*100:.2f}% of "
+                         f"{facts.get('device_count', 1)}x "
+                         f"{facts.get('peak_flops_per_device', 0)/1e12:.0f}"
+                         f" TFLOP/s {facts.get('device_kind', '')}")
+        elif "flops_per_step" in facts:
+            lines.append("MFU               n/a (no peak-FLOPs table entry"
+                         " for this device; set BIGDL_PEAK_FLOPS)")
+
+    if summary["gauges"]:
+        lines.append("")
+        lines.append("-- gauges --")
+        width = max(len(n) for n in summary["gauges"])
+        for name, row in sorted(summary["gauges"].items()):
+            lines.append(f"{name:<{width}}  last {row['last']:g}  "
+                         f"min {row['min']:g}  max {row['max']:g}  "
+                         f"n={int(row['n'])}")
+    return "\n".join(lines)

@@ -1,0 +1,151 @@
+"""The event-log schema: one catalog of event kinds + a validator.
+
+Every line of a run log is one JSON object.  Base fields (all kinds):
+
+| field | type  | meaning                          |
+|-------|-------|----------------------------------|
+| v     | int   | schema version (currently 1)     |
+| ts    | float | epoch seconds at emission        |
+| pid   | int   | OS process id                    |
+| tid   | int   | thread id (one Chrome lane each) |
+| kind  | str   | one of :data:`KINDS`             |
+
+Kind-specific required fields are listed in :data:`KINDS`; extra fields
+are always allowed (attrs travel with their event).  ``validate_run``
+additionally checks the *structural* invariants the Chrome exporter and
+the summary reader rely on: every ``span_begin`` has a matching
+``span_end`` on the same thread, pairs close LIFO (proper nesting), and
+span ids are unique.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Tuple
+
+__all__ = ["KINDS", "validate_event", "validate_events", "validate_run",
+           "read_events"]
+
+_NUM = (int, float)
+
+#: kind -> {required field: type tuple}
+KINDS: Dict[str, Dict[str, tuple]] = {
+    "run_start": {"meta": (dict,)},
+    "run_end": {"dur": _NUM},
+    "span_begin": {"name": (str,), "span": (int,), "parent": (int,),
+                   "depth": (int,)},
+    "span_end": {"name": (str,), "span": (int,), "dur": _NUM},
+    "stage": {"name": (str,), "dur": _NUM},
+    "counter": {"name": (str,), "value": _NUM},
+    "gauge": {"name": (str,), "value": _NUM},
+    "event": {"name": (str,)},
+    "step": {"step": (int,), "dur": _NUM},
+    "compile": {"name": (str,), "dur": _NUM},
+    "retrace": {"rule": (str,), "message": (str,)},
+    "device_facts": {"facts": (dict,)},
+}
+
+_BASE: Dict[str, tuple] = {"v": (int,), "ts": _NUM, "pid": (int,),
+                           "tid": (int,), "kind": (str,)}
+
+
+def validate_event(event: Dict[str, Any]) -> List[str]:
+    """Field-level check of one event; returns human-readable problems
+    (empty when valid)."""
+    errors: List[str] = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not an object"]
+    for field, types in _BASE.items():
+        if field not in event:
+            errors.append(f"missing base field {field!r}")
+        elif not isinstance(event[field], types) \
+                or isinstance(event[field], bool):
+            errors.append(f"base field {field!r} has type "
+                          f"{type(event[field]).__name__}")
+    kind = event.get("kind")
+    if kind not in KINDS:
+        errors.append(f"unknown kind {kind!r}")
+        return errors
+    for field, types in KINDS[kind].items():
+        if field not in event:
+            errors.append(f"{kind}: missing field {field!r}")
+        elif not isinstance(event[field], types) \
+                or isinstance(event[field], bool):
+            errors.append(f"{kind}: field {field!r} has type "
+                          f"{type(event[field]).__name__}")
+    return errors
+
+
+def validate_events(events: Iterable[Dict[str, Any]]) -> List[str]:
+    """Per-event checks plus the structural span invariants: matched
+    begin/end per id, LIFO close order per thread, unique span ids."""
+    errors: List[str] = []
+    stacks: Dict[int, List[Tuple[int, str]]] = {}
+    seen_ids: set = set()
+    for i, ev in enumerate(events):
+        for problem in validate_event(ev):
+            errors.append(f"event {i}: {problem}")
+        kind = ev.get("kind")
+        tid = ev.get("tid")
+        if kind == "span_begin" and isinstance(ev.get("span"), int):
+            sid = ev["span"]
+            if sid in seen_ids:
+                errors.append(f"event {i}: span id {sid} reused")
+            seen_ids.add(sid)
+            stack = stacks.setdefault(tid, [])
+            if ev.get("depth") != len(stack):
+                errors.append(f"event {i}: span {sid} depth "
+                              f"{ev.get('depth')} != stack depth "
+                              f"{len(stack)}")
+            parent = stack[-1][0] if stack else 0
+            if ev.get("parent") != parent:
+                errors.append(f"event {i}: span {sid} parent "
+                              f"{ev.get('parent')} != open span {parent}")
+            stack.append((sid, ev.get("name", "")))
+        elif kind == "span_end" and isinstance(ev.get("span"), int):
+            sid = ev["span"]
+            stack = stacks.setdefault(tid, [])
+            if not stack:
+                errors.append(f"event {i}: span_end {sid} with no open "
+                              f"span on tid {tid}")
+            else:
+                top_sid, top_name = stack.pop()
+                if top_sid != sid:
+                    errors.append(f"event {i}: span_end {sid} closes out "
+                                  f"of order (open span is {top_sid} "
+                                  f"{top_name!r})")
+                elif ev.get("name") != top_name:
+                    errors.append(f"event {i}: span_end {sid} name "
+                                  f"{ev.get('name')!r} != begin name "
+                                  f"{top_name!r}")
+    for tid, stack in stacks.items():
+        for sid, name in stack:
+            errors.append(f"span {sid} {name!r} never closed "
+                          f"(tid {tid})")
+    return errors
+
+
+def read_events(path: str) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Parse a JSONL run log; returns (events, parse errors).  Malformed
+    lines are reported, not fatal — a crashed run may truncate its final
+    line."""
+    events: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError as e:
+                errors.append(f"line {lineno}: not valid JSON ({e})")
+    return events, errors
+
+
+def validate_run(path: str) -> Tuple[int, List[str]]:
+    """Full-file validation: parse + per-event + structural checks.
+    Returns (event count, problems)."""
+    events, errors = read_events(path)
+    errors.extend(validate_events(events))
+    return len(events), errors

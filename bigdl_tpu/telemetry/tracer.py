@@ -1,0 +1,267 @@
+"""The process-wide tracer: structured spans, counters, gauges, and
+instant events appended to one JSON-lines run log.
+
+Deliberately dependency-light (stdlib only at module load): the hot
+layers (``optim/metrics.py``, ``optim/optimizer.py``,
+``parallel/train_step.py``) import this at module load, and when no run
+is active every emit helper is one falsy check — the same contract as
+``analysis/hooks.py``.
+
+Event stream shape (see ``telemetry/schema.py`` for the full schema):
+every line is one JSON object with the base fields ``v`` (schema
+version), ``ts`` (epoch seconds), ``pid`` (OS pid), ``tid`` (thread id),
+``kind``, plus kind-specific fields.  Spans are emitted as explicit
+``span_begin``/``span_end`` pairs (ids, parent, depth) so nesting and
+pairing are checkable properties of the log itself, not of the reader.
+
+Thread model: one lock around sink emission; span stacks are
+thread-local, so each thread's spans nest independently (the Chrome
+exporter renders one lane per tid).  A span left open by an exception is
+closed by :meth:`Tracer.unwind` with ``abandoned: true`` — every begin
+always has an end.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SCHEMA_VERSION", "Tracer", "JsonlSink", "MemorySink"]
+
+SCHEMA_VERSION = 1
+
+
+class JsonlSink:
+    """Append-only JSON-lines file sink (one event per line)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._pending = 0
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(event, separators=(",", ":"),
+                                 default=_json_default) + "\n")
+        self._pending += 1
+        if self._pending >= 32:  # bound loss on a crashed run
+            self._f.flush()
+            self._pending = 0
+
+    def flush(self) -> None:
+        self._f.flush()
+        self._pending = 0
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+
+
+class MemorySink:
+    """In-memory sink for tests and programmatic inspection."""
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        self.events.append(event)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _json_default(obj):
+    """Last-resort encoder: numpy scalars and arrays show up in attrs
+    (losses, shapes) — render them as plain Python, everything else as
+    its repr rather than failing the write."""
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.generic):
+            return obj.item()
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+    except Exception:  # noqa: BLE001 - encoding must never raise
+        pass
+    return repr(obj)
+
+
+class _OpenSpan:
+    __slots__ = ("sid", "name", "t0")
+
+    def __init__(self, sid: int, name: str, t0: float):
+        self.sid = sid
+        self.name = name
+        self.t0 = t0
+
+
+class Tracer:
+    """Emit structured events into a set of sinks.  Construct directly
+    for tests; production code goes through ``telemetry.start_run``."""
+
+    def __init__(self, sinks=(), meta: Optional[Dict[str, Any]] = None):
+        self._lock = threading.Lock()
+        self._sinks = list(sinks)
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        # tid -> the same list the thread-local holds, so close() can
+        # unwind spans a WORKER thread left open (the thread-local view
+        # alone would orphan them and break begin/end pairing)
+        self._stacks: Dict[int, List[_OpenSpan]] = {}
+        self._t_start = time.time()
+        self.meta = dict(meta or {})
+        self.closed = False
+
+    # -- sink management ---------------------------------------------------
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                pass
+
+    # -- raw emission ------------------------------------------------------
+    def emit(self, kind: str, **fields) -> None:
+        event = {"v": SCHEMA_VERSION, "ts": time.time(),
+                 "pid": os.getpid(), "tid": threading.get_ident(),
+                 "kind": kind}
+        event.update(fields)
+        with self._lock:
+            if self.closed:
+                return
+            for sink in self._sinks:
+                try:
+                    sink.emit(event)
+                except Exception:  # noqa: BLE001 - observers never kill the run
+                    pass
+
+    # -- spans -------------------------------------------------------------
+    def _stack(self) -> List[_OpenSpan]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+            with self._lock:
+                self._stacks[threading.get_ident()] = stack
+        return stack
+
+    def begin(self, name: str, **attrs) -> int:
+        """Open a span on the calling thread; returns its id for
+        :meth:`end`.  Prefer :meth:`span` where a with-block fits."""
+        stack = self._stack()
+        sid = next(self._ids)
+        parent = stack[-1].sid if stack else 0
+        depth = len(stack)
+        stack.append(_OpenSpan(sid, name, time.perf_counter()))
+        self.emit("span_begin", name=name, span=sid, parent=parent,
+                  depth=depth, **attrs)
+        return sid
+
+    def end(self, sid: int, **attrs) -> None:
+        """Close the span ``sid``; any deeper spans still open on this
+        thread are closed first (``abandoned: true``) so begin/end pairs
+        stay LIFO in the log.  Unknown ids are a no-op."""
+        stack = self._stack()
+        if not any(s.sid == sid for s in stack):
+            return
+        now = time.perf_counter()
+        while stack:
+            top = stack.pop()
+            if top.sid == sid:
+                self.emit("span_end", name=top.name, span=top.sid,
+                          dur=now - top.t0, **attrs)
+                return
+            self.emit("span_end", name=top.name, span=top.sid,
+                      dur=now - top.t0, abandoned=True)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        sid = self.begin(name, **attrs)
+        try:
+            yield sid
+        finally:
+            self.end(sid)
+
+    def depth(self) -> int:
+        """Number of spans open on the calling thread — capture it at a
+        scope's entry to :meth:`unwind` back to exactly that scope."""
+        return len(self._stack())
+
+    def unwind(self, to_depth: int = 0, **attrs) -> None:
+        """Close spans open on the calling thread down to ``to_depth``
+        (exception paths), newest first, marked ``abandoned: true`` —
+        spans an enclosing caller opened above ``to_depth`` are left
+        untouched."""
+        stack = self._stack()
+        now = time.perf_counter()
+        while len(stack) > to_depth:
+            top = stack.pop()
+            self.emit("span_end", name=top.name, span=top.sid,
+                      dur=now - top.t0, abandoned=True, **attrs)
+
+    # -- scalar streams ----------------------------------------------------
+    def stage(self, name: str, dur: float, **attrs) -> None:
+        """One sample of a named pipeline stage (seconds) — the Metrics
+        accumulator forwards every ``add`` here."""
+        self.emit("stage", name=name, dur=float(dur), **attrs)
+
+    def counter(self, name: str, value: float, **attrs) -> None:
+        self.emit("counter", name=name, value=float(value), **attrs)
+
+    def gauge(self, name: str, value: float, **attrs) -> None:
+        self.emit("gauge", name=name, value=float(value), **attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        """A point-in-time marker (straggler firing, retry, epoch
+        boundary, checkpoint commit)."""
+        self.emit("event", name=name, **attrs)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.emit("run_start", meta=self.meta)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.unwind()
+        # spans other threads left open (a worker that died inside a
+        # span, a straggler still blocked): close them under THEIR tid,
+        # so per-thread pairing stays valid in the final log
+        with self._lock:
+            me = threading.get_ident()
+            others = [(tid, st) for tid, st in self._stacks.items()
+                      if tid != me and st]
+        now = time.perf_counter()
+        for tid, stack in others:
+            while True:
+                # the owning thread may race us ending its own spans:
+                # pop-or-stop, never crash the shutdown
+                try:
+                    top = stack.pop()
+                except IndexError:
+                    break
+                self.emit("span_end", name=top.name, span=top.sid,
+                          dur=now - top.t0, abandoned=True, tid=tid)
+        self.emit("run_end", dur=time.time() - self._t_start)
+        with self._lock:
+            self.closed = True
+            sinks, self._sinks = self._sinks, []
+        for sink in sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass

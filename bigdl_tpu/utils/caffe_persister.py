@@ -109,6 +109,7 @@ class CaffePersister:
         self.blobs: Dict[str, List[np.ndarray]] = {}
         self.customized = dict(customized_emitters or {})
         self._counter = 0
+        self._taken = self._user_names(model, set())
         if input_shapes is None:
             self.input_shapes = {}
         elif isinstance(input_shapes, dict):
@@ -117,14 +118,37 @@ class CaffePersister:
             self.input_shapes = {"data": tuple(input_shapes)}
 
     # -- plumbing ----------------------------------------------------------
+    def _user_names(self, module, out: set) -> set:
+        """Every user-set ``_name`` reachable from ``module`` (container
+        children and graph nodes) — minted names must dodge ALL of them,
+        including ones the emit walk has not reached yet."""
+        nm = getattr(module, "_name", None)
+        if nm:
+            out.add(nm)
+        for sub in getattr(module, "_modules", {}).values():
+            if sub is not None:
+                self._user_names(sub, out)
+        for node in (getattr(module, "_sorted", None) or []):
+            el = getattr(node, "element", None)
+            if el is not None:
+                self._user_names(el, out)
+        return out
+
     def _fresh(self, hint: str) -> str:
-        self._counter += 1
-        return f"{hint}{self._counter}"
+        while True:
+            self._counter += 1
+            name = f"{hint}{self._counter}"
+            if name not in self._taken:
+                self._taken.add(name)
+                return name
 
     def _name_of(self, module, hint: str) -> str:
-        name = module.get_name() if hasattr(module, "get_name") else None
-        cls = type(module).__name__
-        if name and not name.startswith(cls + "@"):  # auto names regenerate
+        # only a user-set name is stable enough to persist: get_name()'s
+        # fallback derives from id() mod 1e5, so two unnamed modules can
+        # collide and silently shadow each other's prototxt layer + blobs
+        # (wrong channel wiring on reload) — auto names regenerate fresh
+        name = getattr(module, "_name", None)
+        if name:
             return name
         return self._fresh(hint)
 
@@ -342,7 +366,7 @@ class CaffePersister:
         tops: Dict[int, str] = {}
         free = list(bottoms)
         for node in graph.input_nodes:
-            nm = node.element.get_name() or self._fresh("data")
+            nm = getattr(node.element, "_name", None) or self._fresh("data")
             tops[node.id] = free.pop(0) if free else nm
         for node in graph._sorted:
             if node.id in tops:
@@ -356,6 +380,7 @@ class CaffePersister:
     def build(self) -> Tuple[Dict, bytes]:
         """(prototxt dict, caffemodel bytes)."""
         self.layers, self.blobs, self._counter = [], {}, 0
+        self._taken = self._user_names(self.model, set())
         net: Dict = {"name": self.net_name}
         input_layers = []
         data_blobs = list(self.input_shapes) or ["data"]

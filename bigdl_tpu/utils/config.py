@@ -24,6 +24,8 @@ test (or an embedding application) can inject overrides with
 | check_singleton_strict | BIGDL_CHECK_SINGLETON       | Engine.check_singleton raise-vs-warn |
 | profile_dir            | BIGDL_PROFILE               | profiler hook |
 | profile_iters          | BIGDL_PROFILE_ITERS         | profiler hook |
+| telemetry_dir          | BIGDL_TELEMETRY             | telemetry run log dir (docs/observability.md) |
+| telemetry_device       | BIGDL_TELEMETRY_DEVICE      | device-facts level: off / auto / full |
 | no_native              | BIGDL_TPU_NO_NATIVE         | native kernel loader |
 | log_disable            | BIGDL_LOGGER_DISABLE        | utils.logging redirect (disable) |
 | log_file               | BIGDL_LOG_FILE              | utils.logging redirect target |
@@ -42,6 +44,7 @@ time inside jitted-program construction):
 | BIGDL_POOL_KERNEL     | ops.pooling_pallas argmax-index pool (off/auto/on/interpret; auto=off — see BASELINE.md postmortem) |
 | BIGDL_COMPILE_CACHE   | Engine.enable_compile_cache persistent XLA executable cache dir |
 | BIGDL_SINGLETON_WAIT  | Engine.check_singleton bounded wait (s) for a lock holder |
+| BIGDL_PEAK_FLOPS      | telemetry.device MFU denominator override (FLOP/s per device) |
 | JAX_PLATFORMS         | honored over externally-registered PJRT plugins via honor_platform_request |
 """
 
@@ -77,6 +80,9 @@ class BigDLConfig:
     # profiling
     profile_dir: Optional[str] = None
     profile_iters: int = 5
+    # telemetry (docs/observability.md): JSONL run logs + device facts
+    telemetry_dir: Optional[str] = None
+    telemetry_device: str = "auto"  # off | auto | full
     # native layer
     no_native: bool = False
     # log management (LoggerFilter.scala property family)
@@ -112,6 +118,9 @@ class BigDLConfig:
             check_singleton_strict=_truthy(env.get("BIGDL_CHECK_SINGLETON")),
             profile_dir=env.get("BIGDL_PROFILE") or None,
             profile_iters=_int("BIGDL_PROFILE_ITERS", 5),
+            telemetry_dir=env.get("BIGDL_TELEMETRY") or None,
+            telemetry_device=(env.get("BIGDL_TELEMETRY_DEVICE")
+                              or "auto").strip().lower(),
             no_native=_truthy(env.get("BIGDL_TPU_NO_NATIVE")),
             log_disable=_truthy(env.get("BIGDL_LOGGER_DISABLE")),
             log_file=env.get("BIGDL_LOG_FILE") or None,

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -32,26 +33,56 @@ def _bucket_limits() -> List[float]:
 
 
 _LIMITS = None
+_LIMITS_LOCK = threading.Lock()
+
+
+def _limits() -> np.ndarray:
+    """The cached bucket-limit table, built once under a lock — histogram
+    writers run on arbitrary threads (the Optimizer's Parameters trigger,
+    FileWriter callers), and a double build could hand one of them a
+    half-published array on weakly-ordered platforms."""
+    global _LIMITS
+    table = _LIMITS
+    if table is None:
+        with _LIMITS_LOCK:
+            if _LIMITS is None:
+                _LIMITS = np.asarray(_bucket_limits())
+            table = _LIMITS
+    return table
 
 
 def histogram_proto(values) -> bytes:
-    """Build a HistogramProto payload from an array of values."""
-    global _LIMITS
-    if _LIMITS is None:
-        _LIMITS = np.asarray(_bucket_limits())
+    """Build a HistogramProto payload from an array of values.
+
+    Degenerate inputs stay renderable: empty/all-NaN arrays histogram a
+    single zero; constant arrays (all-zero included) get a padded
+    min/max so the display range is never empty or inverted; non-finite
+    values are dropped from bucketing (they have no finite bucket) but
+    never corrupt min/max/sum."""
+    limits = _limits()
     v = np.asarray(values, np.float64).reshape(-1)
+    v = v[np.isfinite(v)]
     if v.size == 0:
         v = np.zeros(1)
-    idx = np.searchsorted(_LIMITS, v, side="left")
-    counts = np.bincount(idx, minlength=len(_LIMITS)).astype(np.float64)
+    idx = np.searchsorted(limits, v, side="left")
+    # values beyond the last finite limit land in the +inf bucket, never
+    # past the table (a too-large idx would desync limits and counts)
+    idx = np.minimum(idx, len(limits) - 1)
+    counts = np.bincount(idx, minlength=len(limits)).astype(np.float64)
     # trim empty leading/trailing buckets (TF does the same to keep protos small)
     nz = np.nonzero(counts)[0]
     lo, hi = int(nz[0]), int(nz[-1]) + 1
     lo = max(lo - 1, 0)
-    hi = min(hi + 1, len(_LIMITS))
+    hi = min(hi + 1, len(limits))
+    mn, mx = float(v.min()), float(v.max())
+    if mn == mx:
+        # constant input: pad the display range the way TF's histogram
+        # does, so TensorBoard never sees an empty/inverted [min, max]
+        pad = max(1.0, abs(mn)) * 0.5
+        mn, mx = mn - pad, mx + pad
     return proto.encode_histogram(
-        float(v.min()), float(v.max()), float(v.size), float(v.sum()),
-        float((v * v).sum()), _LIMITS[lo:hi].tolist(),
+        mn, mx, float(v.size), float(v.sum()),
+        float((v * v).sum()), limits[lo:hi].tolist(),
         counts[lo:hi].tolist())
 
 

@@ -262,6 +262,45 @@ def _roundtrip(model, input_shape, x):
     return proto, weights
 
 
+def test_persister_unnamed_modules_get_fresh_unique_names():
+    """``get_name()``'s fallback derives from ``id() % 1e5``, so two
+    unnamed modules can collide and silently shadow each other's layer
+    + blobs in the prototxt — the cause of the intermittent inception_v1
+    roundtrip failure (wrong channel wiring / dangling nodes on reload,
+    dependent on heap layout).  The persister must mint its own fresh
+    names for unnamed modules and keep only user-set ones."""
+    import re
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.caffe_persister import CaffePersister
+
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 1, 1).set_name("conv_explicit"),
+        nn.ReLU(),
+        nn.SpatialConvolution(4, 4, 1, 1),
+        nn.SpatialConvolution(4, 2, 1, 1))
+    p = CaffePersister(model, input_shapes=(1, 3, 8, 8))
+    p.build()
+    names = [lay["name"] for lay in p.layers]
+    assert "conv_explicit" in names
+    assert len(names) == len(set(names))
+    for nm in names:
+        if nm != "conv_explicit":
+            # persister-scoped counter names, never id-derived ones
+            assert not re.fullmatch(r"(SpatialConvolution|ReLU)\d+", nm), nm
+
+    # minted names must also dodge user-set ones wherever they appear in
+    # the model ("conv1" here would be the counter's first conv pick)
+    clash = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 1, 1),
+        nn.SpatialConvolution(4, 4, 1, 1).set_name("conv1"))
+    p = CaffePersister(clash, input_shapes=(1, 3, 8, 8))
+    p.build()
+    names = [lay["name"] for lay in p.layers]
+    assert len(names) == len(set(names)), names
+    assert "conv1" in names
+
+
 def test_persister_sequential_cnn_roundtrip():
     import bigdl_tpu.nn as nn
 

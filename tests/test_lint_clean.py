@@ -15,6 +15,16 @@ def test_repo_sources_lint_clean():
     assert not report.errors, "\n" + report.format()
 
 
+def test_lint_covers_telemetry_package():
+    # bigdl_tpu/telemetry/ is inside the default lint roots; pin that
+    # explicitly (and that it is clean on its own) so a future root
+    # reshuffle can't silently drop the subsystem from CI
+    tele = os.path.join(REPO, "bigdl_tpu", "telemetry")
+    assert os.path.isdir(tele)
+    report = lint_paths([tele])
+    assert not report.errors and not report.warnings, "\n" + report.format()
+
+
 def test_lint_actually_scans_regions():
     # guard against the lint silently matching nothing: the repo has
     # known jitted regions (train_step, ops/control, rnn scan bodies)

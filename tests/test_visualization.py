@@ -3,6 +3,7 @@ API, and Optimizer integration (SURVEY §2.10 / §4 visualization spec)."""
 
 import os
 import struct
+import threading
 
 import numpy as np
 
@@ -60,6 +61,87 @@ def test_histogram_event(tmp_path):
 
     recs = list(_iter_records(os.path.join(d, files[0])))
     assert len(recs) == 2  # version header + histogram event
+
+
+def _decode_histogram(buf: bytes):
+    """Parse a HistogramProto payload back into (min, max, num, limits,
+    buckets) via the repo's own wire codec."""
+    mn = mx = num = None
+    limits, buckets = [], []
+    for field, wire, val in proto._fields(buf):
+        if wire == 1:
+            (x,) = struct.unpack("<d", val)
+            if field == 1:
+                mn = x
+            elif field == 2:
+                mx = x
+            elif field == 3:
+                num = x
+        elif wire == 2 and field in (6, 7):
+            xs = [struct.unpack("<d", val[i:i + 8])[0]
+                  for i in range(0, len(val), 8)]
+            (limits if field == 6 else buckets).extend(xs)
+    return mn, mx, num, limits, buckets
+
+
+def test_histogram_all_zero_has_valid_range():
+    from bigdl_tpu.visualization.summary import histogram_proto
+
+    mn, mx, num, limits, buckets = _decode_histogram(
+        histogram_proto(np.zeros(100)))
+    assert num == 100
+    assert mn < mx, "all-zero input must not produce an empty range"
+    assert len(limits) == len(buckets) >= 1
+    assert limits == sorted(limits)
+    assert all(a < b for a, b in zip(limits, limits[1:]))
+    assert sum(buckets) == 100
+
+
+def test_histogram_constant_has_valid_range():
+    from bigdl_tpu.visualization.summary import histogram_proto
+
+    mn, mx, num, limits, buckets = _decode_histogram(
+        histogram_proto(np.full(50, 3.14)))
+    assert num == 50
+    assert mn < 3.14 < mx, "constant input must not invert min/max"
+    assert sum(buckets) == 50
+    assert all(a < b for a, b in zip(limits, limits[1:]))
+
+
+def test_histogram_nonfinite_and_empty_inputs():
+    from bigdl_tpu.visualization.summary import histogram_proto
+
+    # non-finite values have no finite bucket: dropped, not corrupting
+    mn, mx, num, limits, buckets = _decode_histogram(
+        histogram_proto(np.asarray([np.nan, np.inf, -np.inf, 1.0])))
+    assert num == 1 and sum(buckets) == 1
+    assert mn <= 1.0 <= mx
+    # empty input degrades to a single-zero histogram, not a crash
+    mn, mx, num, limits, buckets = _decode_histogram(histogram_proto([]))
+    assert num == 1 and mn < mx
+
+
+def test_histogram_limits_init_is_thread_safe():
+    from bigdl_tpu.visualization import summary as summary_mod
+    from bigdl_tpu.visualization.summary import histogram_proto
+
+    summary_mod._LIMITS = None  # force a fresh racey initialization
+    data = np.random.default_rng(1).normal(size=256)
+    results = [None] * 8
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        barrier.wait()
+        results[i] = histogram_proto(data)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r == results[0] for r in results)
+    assert summary_mod._LIMITS is not None
 
 
 def test_train_summary_trigger_gating(tmp_path):

@@ -20,18 +20,28 @@ import numpy as np
 def capture(config_name="inception_v1_imagenet", batch=None, iters=8,
             logdir="/tmp/jaxprof"):
     import bench
+    from bigdl_tpu import telemetry
 
-    # the SAME program bench times and hlo_dump prints (incl. graph passes)
-    step, x, y = bench.make_step(config_name, batch)
-    step.aot_scan(x, y, jax.random.key(0), iters)
-    # warmup
-    step.run_scan(x, y, jax.random.key(1), iters)
-    float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    # BIGDL_TELEMETRY: the capture's compile + device facts (emitted by
+    # TrainStep.aot_scan) and the trace window land in the same JSONL
+    # stream the Optimizer and bench.py write — one instrumented path
+    with telemetry.maybe_run(meta={"cmd": "profile_bench",
+                                   "config": config_name}) as owned_log:
+        # SAME program bench times and hlo_dump prints (incl. graph passes)
+        step, x, y = bench.make_step(config_name, batch)
+        step.aot_scan(x, y, jax.random.key(0), iters)
+        # warmup
+        with telemetry.span("profile/warmup", iters=iters):
+            step.run_scan(x, y, jax.random.key(1), iters)
+            float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
 
-    os.system(f"rm -rf {logdir}")
-    with jax.profiler.trace(logdir):
-        step.run_scan(x, y, jax.random.key(2), iters)
-        float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+        os.system(f"rm -rf {logdir}")
+        with telemetry.span("profile/trace", logdir=logdir):
+            with jax.profiler.trace(logdir):
+                step.run_scan(x, y, jax.random.key(2), iters)
+                float(jnp.sum(jax.tree_util.tree_leaves(step.params)[0]))
+    if owned_log:
+        print(f"# telemetry run log: {owned_log}", file=sys.stderr)
     return logdir
 
 
