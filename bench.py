@@ -255,6 +255,9 @@ def _run_config_timed(name, batch, iters):
         cost = step.aot_scan(x, y, jax.random.key(0), iters)
     finally:
         stop_hb.set()
+    from bigdl_tpu.telemetry.device import normalize_cost_analysis
+
+    cost = normalize_cost_analysis(cost)
     compile_s = time.perf_counter() - t_c0
     flash_flops = 0.0
     if cost and cost.get("flops"):
@@ -342,9 +345,9 @@ def run_infer_config(name, batch, iters, quantized):
     compiled = es._build().lower(state, xj).compile()
     ops = None
     try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
+        from bigdl_tpu.telemetry.device import normalize_cost_analysis
+
+        cost = normalize_cost_analysis(compiled.cost_analysis())
         ops = float(cost.get("flops") or 0) or None
     except Exception:  # noqa: BLE001 — accounting must not sink the leg
         pass
@@ -549,7 +552,21 @@ def _init_backend_or_die():
                    "banked measurement")
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="bigdl_tpu benchmark driver (env knobs: BENCH_CONFIGS,"
+                    " BENCH_ITERS, ... — see module docstring)")
+    ap.add_argument("--diff-against", default=None, metavar="BASELINE.json",
+                    help="after the sweep, compare this run's line against"
+                         " a prior bench JSON (or a telemetry run log) via"
+                         " python -m bigdl_tpu.telemetry diff; exit 4 on a"
+                         " regression — the CI perf gate")
+    ap.add_argument("--diff-threshold-pct", type=float, default=None,
+                    help="regression threshold for --diff-against "
+                         "(default: the diff engine's)")
+    args = ap.parse_args(argv)
     _init_backend_or_die()
     # BIGDL_TELEMETRY routes the sweep's per-config stage timings,
     # compiles, and device facts into one JSONL run log (the instrumented
@@ -557,9 +574,30 @@ def main():
     from bigdl_tpu import telemetry
 
     with telemetry.maybe_run(meta={"cmd": "bench"}) as owned_log:
-        _sweep()
+        line = _sweep()
     if owned_log:
         print(f"# telemetry run log: {owned_log}", file=sys.stderr)
+    if args.diff_against:
+        from bigdl_tpu.telemetry import diff as tdiff
+
+        base = tdiff.load_metrics(args.diff_against)
+        cur = tdiff.bench_metrics(line, path="<this sweep>")
+        kwargs = {}
+        if args.diff_threshold_pct is not None:
+            kwargs["threshold_pct"] = args.diff_threshold_pct
+        rows = tdiff.diff_metrics(base, cur, **kwargs)
+        print(tdiff.format_diff(rows, base, cur), file=sys.stderr)
+        if not rows:
+            # nothing comparable (every config errored, or a disjoint
+            # baseline) must FAIL the gate, not silently pass it — the
+            # same contract as `telemetry diff` exit 2
+            print("error: --diff-against found nothing comparable",
+                  file=sys.stderr)
+            sys.exit(2)
+        if any(r["regressed"] for r in rows):
+            # distinct from the wedge/replay exit 3: this sweep RAN, it
+            # just got slower than the baseline
+            sys.exit(4)
 
 
 def _sweep():
@@ -609,6 +647,7 @@ def _sweep():
     if infer is not None:
         line["infer_int8_vs_bf16"] = infer
     print(json.dumps(line))
+    return line
 
 
 def _source_state():

@@ -332,6 +332,13 @@ def main(argv=None) -> None:
                         help="write a JSONL telemetry run log under DIR "
                              "(same as BIGDL_TELEMETRY; inspect with "
                              "python -m bigdl_tpu.telemetry)")
+        sp.add_argument("--metrics-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve live OpenMetrics (/metrics) + JSON "
+                             "status (/status) on PORT while the run is "
+                             "alive (0 = ephemeral; same as "
+                             "BIGDL_METRICS_PORT; needs --telemetry or "
+                             "BIGDL_TELEMETRY)")
 
     t = sub.add_parser("train", help="train a zoo model")
     common(t)
@@ -371,6 +378,8 @@ def main(argv=None) -> None:
         # the env route keeps one resolution path (utils/config.py);
         # the Optimizer / perf harness start the run from config
         os.environ["BIGDL_TELEMETRY"] = args.telemetry
+    if getattr(args, "metrics_port", None) is not None:
+        os.environ["BIGDL_METRICS_PORT"] = str(args.metrics_port)
     args.fn(args)
 
 

@@ -5,6 +5,8 @@ from bigdl_tpu.optim.trigger import Trigger  # noqa: F401
 from bigdl_tpu.optim.validation import *  # noqa: F401,F403
 from bigdl_tpu.optim.regularizer import *  # noqa: F401,F403
 from bigdl_tpu.optim.metrics import Metrics  # noqa: F401
-from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, DistriOptimizer  # noqa: F401
+from bigdl_tpu.optim.optimizer import (Optimizer, LocalOptimizer,  # noqa: F401
+                                       DistriOptimizer, HealthError,
+                                       HealthPolicy)
 from bigdl_tpu.optim.evaluator import Evaluator  # noqa: F401
 from bigdl_tpu.optim.predictor import LocalPredictor, Predictor  # noqa: F401

@@ -32,9 +32,32 @@ __all__ = ["Metrics"]
 
 
 class Metrics:
+    """Thread-safe per-stage accumulator.  ``add``/``set``/``timer`` are
+    called concurrently by the driver loop, the prefetch worker, the
+    straggler runner, and the async-checkpoint pool — every read and
+    write of ``_scalars`` happens under one lock (the telemetry forward
+    happens outside it: the tracer has its own).  ``stages()`` and
+    ``summary()`` report in STABLE pipeline order — the canonical stage
+    sequence first, then unknown stages in first-recorded order — so two
+    summaries of the same run are comparable line-by-line."""
+
+    #: the host-loop pipeline order (docs/observability.md): stages are
+    #: reported in execution order, not alphabetically
+    _STAGE_ORDER = ("data time", "host to device time",
+                    "host to device time (overlapped)", "dispatch time",
+                    "compile + first iteration time", "computing time",
+                    "validation time", "checkpoint time",
+                    "checkpoint wait time")
+
     def __init__(self):
         self._lock = threading.Lock()
         self._scalars: Dict[str, List[float]] = {}
+
+    def _ordered(self) -> List[str]:
+        """Stage names in canonical order (call with the lock held)."""
+        known = [n for n in self._STAGE_ORDER if n in self._scalars]
+        return known + [n for n in self._scalars
+                        if n not in self._STAGE_ORDER]
 
     def set(self, name: str, value: float):
         with self._lock:
@@ -62,7 +85,7 @@ class Metrics:
 
     def stages(self) -> List[str]:
         with self._lock:
-            return sorted(self._scalars)
+            return self._ordered()
 
     @contextmanager
     def timer(self, name: str):
@@ -79,10 +102,11 @@ class Metrics:
 
     def summary(self, unit_scale: float = 1.0) -> str:
         """Pretty printer mirroring ``Metrics.summary``: per-stage mean,
-        total, and sample count."""
+        total, and sample count, in canonical pipeline order."""
         with self._lock:
             lines = ["========== Metrics Summary =========="]
-            for name, vals in sorted(self._scalars.items()):
+            for name in self._ordered():
+                vals = self._scalars[name]
                 mean = sum(vals) / len(vals) if vals else 0.0
                 lines.append(
                     f"{name} : mean {mean * unit_scale:.6f} s "

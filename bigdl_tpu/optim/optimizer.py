@@ -35,13 +35,15 @@ from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.parallel.train_step import EvalStep, TrainStep
+from bigdl_tpu.telemetry.health import (HealthError, HealthPolicy,
+                                        probe_stats)
 from bigdl_tpu.utils import file as File
 from bigdl_tpu.utils.config import get_config
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.rng import RNG
 
 __all__ = ["Optimizer", "LocalOptimizer", "DistriOptimizer",
-           "StragglerTimeout"]
+           "StragglerTimeout", "HealthError", "HealthPolicy"]
 
 
 class StragglerTimeout(RuntimeError):
@@ -215,6 +217,9 @@ class Optimizer:
         self._grad_clip = None
         self._grad_clip_norm = None
         self._mesh = None  # set by subclass
+        # training health (docs/observability.md): None = resolve from
+        # BIGDL_HEALTH at optimize() time
+        self._health_policy: Optional[HealthPolicy] = None
 
     # -- fluent config (Optimizer.scala:42-265) ----------------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -300,6 +305,17 @@ class Optimizer:
 
     def set_gradient_clipping_by_l2_norm(self, max_norm: float) -> "Optimizer":
         self._grad_clip_norm = max_norm
+        return self
+
+    def set_health_policy(self, policy: Optional[HealthPolicy]) -> "Optimizer":
+        """Install a training-health policy (``telemetry/health.py``):
+        numeric-health probes in the compiled step, loss-spike/plateau
+        EWMA detection, and warn / skip-step / halt actions.  When never
+        called, the policy comes from ``BIGDL_HEALTH`` /
+        ``BIGDL_HEALTH_HALT_AFTER`` (default: halt after 3 consecutive
+        nonfinite steps).  Pass a policy with ``on_nonfinite="off"`` (or
+        set ``BIGDL_HEALTH=off``) to disable the probes entirely."""
+        self._health_policy = policy
         return self
 
     # -- checkpointing -----------------------------------------------------
@@ -563,6 +579,10 @@ class Optimizer:
         retry_times = cfg.failure_retry_times
         retry_window = cfg.failure_retry_interval
         failures: List[float] = []
+        # a bad BIGDL_HEALTH / halt_after is a CONFIG error — surface it
+        # here, before the retry loop, or it would be retried to budget
+        # exhaustion as if it were a transient training failure
+        self._resolve_health_policy()
         self._init_checkpoint_dir()
         self._telemetry_begin(cfg)
         try:
@@ -570,6 +590,12 @@ class Optimizer:
                 try:
                     return self._optimize_once()
                 except KeyboardInterrupt:
+                    raise
+                except HealthError:
+                    # a policy halt is a VERDICT, not a failure — the
+                    # model is diverged and a checkpoint restore would
+                    # just replay the divergence; never burn the retry
+                    # budget on it
                     raise
                 except Exception as e:  # noqa: BLE001 — retry loop parity
                     now = time.time()
@@ -588,14 +614,27 @@ class Optimizer:
         finally:
             self._telemetry_end()
 
+    def _resolve_health_policy(self) -> Optional[HealthPolicy]:
+        policy = self._health_policy
+        if policy is None:
+            policy = HealthPolicy.from_config(get_config())
+        if policy is not None and not policy.enabled:
+            return None
+        # fresh state per run ATTEMPT: a checkpoint restore rewinds the
+        # steps the old counters/EWMA were built on
+        return policy.fresh() if policy is not None else None
+
     def _optimize_once(self):
         mesh = self._mesh
+        health = self._resolve_health_policy()
         step = TrainStep(
             self.model, self.criterion, self.optim_method, mesh=mesh,
             parameter_sync=self.parameter_sync,
             gradient_compression=self.gradient_compression,
             compute_dtype=self.compute_dtype,
-            gradient_clipping=self._grad_clip, max_norm=self._grad_clip_norm)
+            gradient_clipping=self._grad_clip, max_norm=self._grad_clip_norm,
+            health_probe=health is not None,
+            skip_nonfinite=health is not None and health.skip_nonfinite)
         # resume functional optimizer state if the method carries it
         if "func_state" in self.optim_method.state:
             restored = jax.tree.map(np.asarray, self.optim_method.state["func_state"])
@@ -729,6 +768,12 @@ class Optimizer:
                               dur=t_end - t_start, loss=loss, records=n,
                               throughput=throughput,
                               epoch=self.state["epoch"])
+                if health is not None:
+                    # may raise HealthError (never retried — see
+                    # optimize()); the probe values are already
+                    # materialized by the loss sync above, so this is a
+                    # 5-float d2h copy, not a device round-trip
+                    self._health_observe(health, step, loss)
                 log.info(
                     f"[Epoch {self.state['epoch']} {records_this_epoch}/{dataset_size}]"
                     f"[Iteration {self.state['neval']}] Trained {n} records in "
@@ -807,6 +852,48 @@ class Optimizer:
         self._join_checkpoint_write()  # run ends with all writes landed
         log.info(self.metrics.summary())
         return self.model
+
+    # -- training health (docs/observability.md) ----------------------------
+    def _health_observe(self, policy: HealthPolicy, step: TrainStep,
+                        loss: float) -> None:
+        """Fold this iteration's in-graph probe into the policy: emit the
+        typed ``health`` event + finding instants, mirror the probe into
+        TrainSummary scalars, log warnings, and raise
+        :class:`HealthError` when the halt predicate fires."""
+        if step.last_health is None:
+            return
+        n = self.state["neval"]
+        try:
+            stats = probe_stats(np.asarray(step.last_health), loss)
+        except Exception as e:  # noqa: BLE001 - a probe fetch must not
+            # kill a healthy run; the step itself already succeeded
+            log.warning(f"[Health] probe fetch failed at step {n} "
+                        f"({type(e).__name__}: {e})")
+            return
+        telemetry.emit("health", step=n, **stats)
+        action, findings = policy.observe(n, stats)
+        for name, attrs in findings:
+            telemetry.instant(name, **attrs)
+        ts = self._train_summary
+        if ts is not None:
+            gate = getattr(ts, "should_write",
+                           lambda tag, st: tag != "Parameters")
+            if gate("Health", self.state):
+                for key in ("grad_norm", "update_ratio",
+                            "nonfinite_grads", "nonfinite_params"):
+                    ts.add_scalar(f"health/{key}", stats[key], n)
+        if action == "ok":
+            return
+        names = ", ".join(name for name, _ in findings)
+        log.warning(f"[Health] step {n}: {names} "
+                    f"(loss={stats['loss']:.4g}, "
+                    f"grad_norm={stats['grad_norm']:.4g}, "
+                    f"update_ratio={stats['update_ratio']:.4g})")
+        if action == "halt":
+            consec = policy.state["consecutive_nonfinite"]
+            reason = (f"{consec} consecutive nonfinite step(s)" if consec
+                      else "halt_when trigger fired")
+            raise HealthError(n, reason, policy.evidence(n, stats))
 
     # -- straggler guard (docs/straggler.md) --------------------------------
     def _straggler_timeout(self) -> Optional[float]:

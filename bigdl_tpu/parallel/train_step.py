@@ -113,6 +113,17 @@ class TrainStep:
     semantics).
     ``compute_dtype``: e.g. jnp.bfloat16 to run fwd/bwd in bf16 with f32
     master params.
+    ``health_probe``: compute the fused numeric-health reduction per
+    step (global grad/param/update norms + nonfinite counts,
+    ``telemetry/health.py PROBE_FIELDS``) as an extra step output,
+    stored on ``self.last_health`` — an async device array whose values
+    are ready once the loss fetch the driver already performs has
+    synced, so reading it is a d2h copy, not another device sync.
+    ``skip_nonfinite``: additionally KEEP the previous
+    params/opt-state/buffers (in-graph select) whenever the step's
+    gradients, updated params, or loss are nonfinite — the poisoned
+    update never lands (donation-safe: the select is part of the same
+    compiled program).
     """
 
     def __init__(self, model: Module, criterion, optim_method, mesh=None,
@@ -123,7 +134,9 @@ class TrainStep:
                  extra_sharding_rules: Optional[Callable] = None,
                  gradient_clipping: Optional[Tuple[float, float]] = None,
                  max_norm: Optional[float] = None,
-                 remat: bool = False):
+                 remat: bool = False,
+                 health_probe: bool = False,
+                 skip_nonfinite: bool = False):
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -141,6 +154,9 @@ class TrainStep:
         self.gradient_clipping = gradient_clipping
         self.max_norm = max_norm
         self.remat = remat
+        self.health_probe = health_probe
+        self.skip_nonfinite = skip_nonfinite
+        self.last_health = None  # device [5] vector, see PROBE_FIELDS
 
         self.params = state_dict(model, kind="param")
         self.buffers = state_dict(model, kind="buffer")
@@ -232,15 +248,18 @@ class TrainStep:
             self._opt_state_shardings(self.opt_state))
 
     # -- the pure step -----------------------------------------------------
-    def _step_fn(self):
+    def _step_fn(self, with_health: bool = False):
         """The pure (params, opt_state, buffers, x, y, key) -> (params,
-        opt_state, buffers, loss) function, shared by the per-iteration
-        jit and the scan-of-iterations jit."""
+        opt_state, buffers, loss[, health]) function, shared by the
+        per-iteration jit and the scan-of-iterations jit.
+        ``with_health`` appends the fused health 5-vector output (the
+        per-iteration path only — the scan path keeps the 4-tuple)."""
         model, criterion, optim = self.model, self.criterion, self.optim
         meta = self._meta
         comp = self.gradient_compression
         cdt = self.compute_dtype
         mesh = self.mesh
+        skip_nonfinite = self.skip_nonfinite
 
         def loss_fn(params, buffers, x, y, key):
             call_params = params
@@ -308,12 +327,48 @@ class TrainStep:
                 new_params = {
                     k: jax.lax.with_sharding_constraint(v, self._param_sharding(k, v))
                     for k, v in new_params.items()}
+            health = None
+            if with_health or skip_nonfinite:
+                # ONE fused reduction pass over the grad/param trees:
+                # global grad/param/update norms + nonfinite counts.
+                # XLA fuses the per-leaf partial sums into the step's
+                # existing elementwise work; the scalars ride the step's
+                # output fetch (no extra device->host sync).
+                gsq = psq = usq = jnp.float32(0.0)
+                gbad = pbad = jnp.int32(0)
+                for k, g in scaled.items():
+                    g32 = g.astype(jnp.float32)
+                    p32 = params[k].astype(jnp.float32)
+                    n32 = new_params[k].astype(jnp.float32)
+                    d32 = n32 - p32
+                    gsq += jnp.sum(g32 * g32)
+                    psq += jnp.sum(p32 * p32)
+                    usq += jnp.sum(d32 * d32)
+                    gbad += jnp.sum((~jnp.isfinite(g32)).astype(jnp.int32))
+                    pbad += jnp.sum((~jnp.isfinite(n32)).astype(jnp.int32))
+                health = jnp.stack(
+                    [jnp.sqrt(gsq), jnp.sqrt(psq), jnp.sqrt(usq),
+                     gbad.astype(jnp.float32), pbad.astype(jnp.float32)])
+                if skip_nonfinite:
+                    # poisoned step: keep the previous state wholesale
+                    # (params, optimizer moments, BN buffers) — the
+                    # in-graph analogue of drop-gradients-and-continue
+                    ok = (gbad == 0) & (pbad == 0) & jnp.isfinite(loss)
+                    keep = lambda n, o: jnp.where(ok, n, o)
+                    new_params = {k: keep(v, params[k])
+                                  for k, v in new_params.items()}
+                    new_opt = jax.tree.map(keep, new_opt, opt_state)
+                    new_buffers = {k: keep(v, buffers[k])
+                                   for k, v in new_buffers.items()}
+            if with_health:
+                return new_params, new_opt, new_buffers, loss, health
             return new_params, new_opt, new_buffers, loss
 
         return step
 
     def _build(self):
-        return jax.jit(self._step_fn(), donate_argnums=(0, 1, 2))
+        return jax.jit(self._step_fn(with_health=self.health_probe),
+                       donate_argnums=(0, 1, 2))
 
     def _build_scan(self, n: int, stacked: bool):
         """n train iterations inside ONE compiled call via ``lax.scan`` —
@@ -378,8 +433,13 @@ class TrainStep:
         tracer = _telemetry.get()
         before = _jit_cache_size(self._compiled) if tracer else None
         t0 = time.perf_counter()
-        self.params, self.opt_state, self.buffers, loss = self._compiled(
+        out = self._compiled(
             self.params, self.opt_state, self.buffers, x, y, key)
+        if self.health_probe:
+            (self.params, self.opt_state, self.buffers, loss,
+             self.last_health) = out
+        else:
+            self.params, self.opt_state, self.buffers, loss = out
         if tracer is not None:
             first = _note_compile(tracer, self, kind, before,
                                   t0, self._compiled)

@@ -32,11 +32,12 @@ from bigdl_tpu.telemetry.tracer import (SCHEMA_VERSION, JsonlSink,
 
 __all__ = ["SCHEMA_VERSION", "Tracer", "JsonlSink", "MemorySink",
            "enabled", "get", "start_run", "end_run", "run", "maybe_run",
-           "last_run_path", "span", "stage", "counter", "gauge",
-           "instant", "emit"]
+           "last_run_path", "metrics_server", "span", "stage", "counter",
+           "gauge", "instant", "emit"]
 
 _active: Optional[Tracer] = None
 _last_run_path: Optional[str] = None
+_metrics_server = None
 _lifecycle_lock = threading.Lock()
 
 
@@ -55,6 +56,13 @@ def last_run_path() -> Optional[str]:
     """Path of the most recent JSONL run log (survives ``end_run`` so a
     CLI can point the user at the artifact it just produced)."""
     return _last_run_path
+
+
+def metrics_server():
+    """The live OpenMetrics HTTP server bound to the active run, or None
+    (``BIGDL_METRICS_PORT`` unset / no run active).  ``.port`` carries
+    the bound port — the way to discover an ephemeral ``:0`` bind."""
+    return _metrics_server
 
 
 def _default_meta() -> Dict[str, Any]:
@@ -80,34 +88,66 @@ def start_run(path_or_dir: Optional[str] = None,
     ``run-<stamp>-<pid>.jsonl``; None writes to no file (pass ``sinks``,
     e.g. a MemorySink, instead).  Raises if a run is already active —
     nested runs would interleave two schedules into one file."""
-    global _active, _last_run_path
+    global _active, _last_run_path, _metrics_server
     with _lifecycle_lock:
         if _active is not None:
             raise RuntimeError("a telemetry run is already active; "
                                "end_run() it first")
+        full_meta = _default_meta()
+        full_meta.update(meta or {})
         all_sinks = list(sinks or [])
         if path_or_dir is not None:
             path = path_or_dir
             if not path.endswith(".jsonl"):
                 stamp = time.strftime("%Y%m%d_%H%M%S")
-                path = os.path.join(path_or_dir,
-                                    f"run-{stamp}-{os.getpid()}.jsonl")
+                pidx = full_meta.get("process_index", 0)
+                path = os.path.join(
+                    path_or_dir,
+                    f"run-{stamp}-p{pidx}-{os.getpid()}.jsonl")
             all_sinks.append(JsonlSink(path))
             _last_run_path = path
-        full_meta = _default_meta()
-        full_meta.update(meta or {})
         tracer = Tracer(sinks=all_sinks, meta=full_meta)
         tracer.start()
         _active = tracer
+        _metrics_server = _maybe_serve_metrics(tracer)
         return tracer
 
 
+def _maybe_serve_metrics(tracer):
+    """Bring up the OpenMetrics/status HTTP endpoint for this run when
+    ``BIGDL_METRICS_PORT`` names a port (0 = ephemeral).  Failure to
+    bind degrades to a warning — the exporter is an observer."""
+    from bigdl_tpu.utils.config import get_config
+
+    port = get_config().metrics_port
+    if port is None:
+        return None
+    try:
+        from bigdl_tpu.telemetry.metrics_http import start_server
+
+        server = start_server(tracer, port)
+        tracer.emit("event", name="metrics/serving", port=server.port)
+        return server
+    except Exception as e:  # noqa: BLE001 - observers never kill the run
+        import logging
+
+        logging.getLogger("bigdl_tpu.telemetry").warning(
+            "metrics endpoint disabled (%s: %s)", type(e).__name__, e)
+        return None
+
+
 def end_run() -> None:
-    """Close the active run (flushes and closes sinks); no-op when no
-    run is active."""
-    global _active
+    """Close the active run (flushes and closes sinks, stops the metrics
+    endpoint); no-op when no run is active."""
+    global _active, _metrics_server
     with _lifecycle_lock:
         tracer, _active = _active, None
+        server, _metrics_server = _metrics_server, None
+    if server is not None:
+        try:
+            server.stop()
+        except Exception:  # noqa: BLE001 - shutdown must never raise
+            pass
     if tracer is not None:
         tracer.close()
 

@@ -1,7 +1,8 @@
-"""CLI: ``python -m bigdl_tpu.telemetry <run.jsonl>`` — inspect a run.
+"""CLI: ``python -m bigdl_tpu.telemetry ...`` — inspect and compare runs.
 
 Default output: the summary report (per-stage time table, step-time
-p50/p95, compile/retrace/event timeline, device facts + MFU estimate).
+p50/p95, compile/retrace/event timeline, device facts + MFU estimate,
+training-health section).
 
 Options::
 
@@ -9,6 +10,14 @@ Options::
     python -m bigdl_tpu.telemetry run.jsonl --json           # machine view
     python -m bigdl_tpu.telemetry run.jsonl --chrome t.json  # chrome://tracing
     python -m bigdl_tpu.telemetry run.jsonl --validate       # schema check
+    python -m bigdl_tpu.telemetry p0.jsonl p1.jsonl ...      # fleet view
+    python -m bigdl_tpu.telemetry diff old.jsonl new.jsonl   # regression
+    python -m bigdl_tpu.telemetry diff old_bench.json new_bench.json
+
+Passing several run logs merges them into the multi-host fleet view
+(per-process step progress + step-skew).  ``diff`` compares two runs
+(JSONL logs or bench.py JSON, mixed freely) and exits nonzero when the
+candidate regressed beyond the thresholds — the CI gate.
 """
 
 from __future__ import annotations
@@ -19,38 +28,68 @@ import sys
 
 from bigdl_tpu.telemetry import schema
 from bigdl_tpu.telemetry.chrome_trace import write_chrome_trace
-from bigdl_tpu.telemetry.report import format_summary, summarize
+from bigdl_tpu.telemetry.report import (fleet_summarize, format_fleet,
+                                        format_summary, summarize)
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "diff":
+        from bigdl_tpu.telemetry import diff as diff_mod
+
+        return diff_mod.main(argv[1:])
+
     p = argparse.ArgumentParser(
         prog="bigdl_tpu.telemetry",
-        description="summarize / export a telemetry run log")
-    p.add_argument("run", help="path to a run-*.jsonl event log")
+        description="summarize / compare / export telemetry run logs "
+                    "(subcommand: diff <runA> <runB>)")
+    p.add_argument("runs", nargs="+", metavar="run.jsonl",
+                   help="path(s) to run-*.jsonl event logs; several "
+                        "merge into the fleet view")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as JSON instead of text")
     p.add_argument("--chrome", metavar="OUT.json", default=None,
                    help="also write a Chrome trace_event JSON for "
-                        "chrome://tracing / Perfetto")
+                        "chrome://tracing / Perfetto (single run only)")
     p.add_argument("--validate", action="store_true",
-                   help="only validate the log against the schema; "
+                   help="only validate the log(s) against the schema; "
                         "exit 1 on any violation")
     args = p.parse_args(argv)
+    if args.chrome and len(args.runs) > 1:
+        p.error("--chrome exports one run; pass a single run log")
 
-    events, parse_errors = schema.read_events(args.run)
     if args.validate:
-        errors = parse_errors + schema.validate_events(events)
+        total_events = 0
+        errors = []
+        for path in args.runs:
+            events, parse_errors = schema.read_events(path)
+            total_events += len(events)
+            errors += [f"{path}: {e}" for e in
+                       parse_errors + schema.validate_events(events)]
         if errors:
             for e in errors:
                 print(e, file=sys.stderr)
-            print(f"{len(events)} events, {len(errors)} problems")
+            print(f"{total_events} events, {len(errors)} problems")
             return 1
-        print(f"{len(events)} events, schema ok")
+        print(f"{total_events} events, schema ok")
         return 0
 
-    for e in parse_errors:  # non-fatal: a crashed run truncates a line
-        print(f"warning: {e}", file=sys.stderr)
+    loaded = []
+    for path in args.runs:
+        events, parse_errors = schema.read_events(path)
+        for e in parse_errors:  # non-fatal: a crashed run truncates a line
+            print(f"warning: {path}: {e}", file=sys.stderr)
+        loaded.append((path, events))
 
+    if len(loaded) > 1:
+        fleet = fleet_summarize(loaded)
+        if args.json:
+            print(json.dumps(fleet, indent=2, default=str))
+        else:
+            print(format_fleet(fleet))
+        return 0
+
+    path, events = loaded[0]
     summary = summarize(events)
     if args.json:
         print(json.dumps(summary, indent=2, default=str))

@@ -78,6 +78,10 @@ class SummaryBridge:
             self._summary.add_scalar(
                 self._prefix + str(event.get("name", "?")),
                 float(event.get("value", 0.0)), self._step)
+        # NOT forwarded: "health" events — the Optimizer already mirrors
+        # the probe into gated `health/*` scalars itself (and does so
+        # even when no telemetry run is active); forwarding here would
+        # write the same four values per step under a second tag
 
     def flush(self) -> None:
         pass

@@ -25,9 +25,9 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-__all__ = ["peak_flops_per_device", "cost_facts", "memory_facts",
-           "live_memory_facts", "donated_bytes", "collect_device_facts",
-           "mfu_estimate"]
+__all__ = ["peak_flops_per_device", "normalize_cost_analysis",
+           "cost_facts", "memory_facts", "live_memory_facts",
+           "donated_bytes", "collect_device_facts", "mfu_estimate"]
 
 #: per-chip dense bf16 peak FLOP/s by device_kind prefix (the bench.py
 #: table's sibling — shared convention: BIGDL_PEAK_FLOPS overrides).
@@ -62,14 +62,21 @@ def peak_flops_per_device(device_kind: str) -> Optional[float]:
     return best[1] if best else None
 
 
+def normalize_cost_analysis(cost) -> Dict[str, Any]:
+    """``cost_analysis()`` returns a dict on some backends/JAX versions
+    and a one-element list of dicts on others — always hand back the
+    dict (shared by bench.py's two call sites and :func:`cost_facts`)."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
 def cost_facts(lowered) -> Dict[str, Any]:
     """flops / bytes accessed from a ``jax.stages.Lowered`` (HLO-level
     cost analysis — no XLA compile)."""
     out: Dict[str, Any] = {}
     try:
-        cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
+        cost = normalize_cost_analysis(lowered.cost_analysis())
         if cost.get("flops"):
             out["flops_per_step"] = float(cost["flops"])
         if cost.get("bytes accessed"):

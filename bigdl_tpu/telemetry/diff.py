@@ -1,0 +1,229 @@
+"""Run-regression diff: compare two telemetry artifacts and say whether
+the second one got worse.
+
+``python -m bigdl_tpu.telemetry diff <runA> <runB>`` accepts either
+JSONL run logs (anything ``schema.read_events`` parses) or ``bench.py``
+output JSON (one object with a ``configs`` table) — in any combination,
+as long as both sides expose comparable metrics.  Compared, when
+present on both sides:
+
+- step p50 / p95 / mean seconds        (lower is better, pct threshold)
+- throughput (records/s, images/s)     (higher is better, pct threshold)
+- data-wait share of iteration time    (lower is better, pct threshold)
+- MFU                                  (higher is better, pct threshold)
+- compile / retrace counts             (count slack, default 0)
+- health-event counts (nonfinite steps, spikes, ...) (count slack)
+
+Exit code contract (CI-ready): 0 = no regression, 1 = at least one
+metric regressed beyond its threshold, 2 = inputs not comparable.
+``bench.py --diff-against <baseline.json>`` delegates here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["load_metrics", "run_log_metrics", "bench_metrics",
+           "diff_metrics", "format_diff", "DEFAULT_THRESHOLD_PCT"]
+
+DEFAULT_THRESHOLD_PCT = 10.0
+
+#: metric name -> (direction, kind); direction "lower"/"higher" is the
+#: GOOD direction, kind "pct" uses the relative threshold, "count" the
+#: absolute slack.  Per-config bench metrics are matched by suffix.
+_RULES: List[Tuple[str, str, str]] = [
+    ("step_p50_s", "lower", "pct"),
+    ("step_p95_s", "lower", "pct"),
+    ("step_mean_s", "lower", "pct"),
+    ("throughput", "higher", "pct"),
+    ("data_wait_share", "lower", "pct"),
+    ("mfu", "higher", "pct"),
+    ("compiles", "lower", "count"),
+    ("retraces", "lower", "count"),
+    ("health_events", "lower", "count"),
+    ("nonfinite_steps", "lower", "count"),
+    (".images_per_sec", "higher", "pct"),
+    (".mfu", "higher", "pct"),
+]
+
+
+def _rule_for(name: str) -> Optional[Tuple[str, str]]:
+    for key, direction, kind in _RULES:
+        if name == key or (key.startswith(".") and name.endswith(key)):
+            return direction, kind
+    return None
+
+
+# -- loading -----------------------------------------------------------------
+def run_log_metrics(path: str) -> Dict[str, Any]:
+    """Comparable metrics out of one JSONL run log (via the report
+    summarizer)."""
+    from bigdl_tpu.telemetry import schema
+    from bigdl_tpu.telemetry.report import summarize
+
+    events, _ = schema.read_events(path)
+    summary = summarize(events)
+    st = summary["steps"]
+    stages = summary["stages"]
+    out: Dict[str, Any] = {"kind": "run_log", "path": path,
+                           "steps": st["count"]}
+    if st["count"]:
+        out["step_p50_s"] = st["p50_s"]
+        out["step_p95_s"] = st["p95_s"]
+        out["step_mean_s"] = st["mean_s"]
+        if "throughput_mean" in st:
+            out["throughput"] = st["throughput_mean"]
+    # data-wait share: driver stall waiting for input, over the total
+    # iteration time.  The Optimizer records the SAME interval twice —
+    # as the data_wait span and as the Metrics-forwarded "data time"
+    # stage — so take one (the span when present), never their sum
+    if "data_wait" in stages:
+        wait = stages["data_wait"]["total_s"]
+    else:
+        wait = stages.get("data time", {}).get("total_s", 0.0)
+    iter_total = stages.get("train/iteration", {}).get("total_s", 0.0) \
+        or st.get("total_s", 0.0)
+    if iter_total:
+        out["data_wait_share"] = wait / iter_total
+    if summary.get("mfu") is not None:
+        out["mfu"] = summary["mfu"]
+    out["compiles"] = len(summary["compiles"])
+    out["retraces"] = len(summary["retraces"])
+    health = summary.get("health", {})
+    out["health_events"] = sum(health.get("events", {}).values())
+    out["nonfinite_steps"] = health.get("nonfinite_steps", 0)
+    return out
+
+
+def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
+    """Comparable metrics out of one bench.py JSON line (the object with
+    the per-config ``configs`` table)."""
+    out: Dict[str, Any] = {"kind": "bench", "path": path}
+    for name, row in (doc.get("configs") or {}).items():
+        if not isinstance(row, dict) or "error" in row:
+            continue
+        if row.get("images_per_sec") is not None:
+            out[f"{name}.images_per_sec"] = float(row["images_per_sec"])
+        if row.get("mfu") is not None:
+            out[f"{name}.mfu"] = float(row["mfu"])
+    if doc.get("value") is not None and not doc.get("configs"):
+        out["throughput"] = float(doc["value"])
+    if doc.get("mfu") is not None:
+        out["mfu"] = float(doc["mfu"])
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, Any]:
+    """Sniff ``path`` (bench JSON object vs JSONL run log) and load the
+    comparable metrics."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path, "r", encoding="utf-8") as fh:
+        head = fh.read(1 << 20)
+    try:
+        doc = json.loads(head)
+        if isinstance(doc, dict) and "kind" not in doc:
+            return bench_metrics(doc, path)
+    except ValueError:
+        pass
+    return run_log_metrics(path)
+
+
+# -- comparing ---------------------------------------------------------------
+def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
+                 threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+                 count_slack: int = 0) -> List[Dict[str, Any]]:
+    """Compare metric dicts (A = baseline, B = candidate).  Returns one
+    row per comparable metric: ``{name, a, b, delta_pct, better,
+    regressed}``, regressions first."""
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(set(a) & set(b)):
+        rule = _rule_for(name)
+        if rule is None:
+            continue
+        direction, kind = rule
+        va, vb = a[name], b[name]
+        if not isinstance(va, (int, float)) \
+                or not isinstance(vb, (int, float)):
+            continue
+        delta = vb - va
+        delta_pct = (delta / abs(va) * 100.0) if va else None
+        worse = delta > 0 if direction == "lower" else delta < 0
+        if kind == "count":
+            regressed = worse and abs(delta) > count_slack
+        elif delta_pct is None:
+            # zero baseline: any move in the bad direction IS the
+            # regression (0 -> anything is an infinite pct change)
+            regressed = worse and abs(delta) > 1e-9
+        else:
+            regressed = worse and abs(delta_pct) > threshold_pct
+        rows.append({"name": name, "a": va, "b": vb,
+                     "delta_pct": delta_pct, "better": direction,
+                     "regressed": bool(regressed)})
+    rows.sort(key=lambda r: (not r["regressed"], r["name"]))
+    return rows
+
+
+def format_diff(rows: List[Dict[str, Any]], a: Dict[str, Any],
+                b: Dict[str, Any]) -> str:
+    lines = [f"== telemetry diff ==",
+             f"A (baseline):  {a.get('path', '?')} [{a.get('kind')}]",
+             f"B (candidate): {b.get('path', '?')} [{b.get('kind')}]"]
+    if not rows:
+        lines.append("no comparable metrics on both sides")
+        return "\n".join(lines)
+    width = max(len(r["name"]) for r in rows)
+    for r in rows:
+        pct = (f"{r['delta_pct']:+8.2f}%" if r["delta_pct"] is not None
+               else f"{r['b'] - r['a']:+9.3g}")  # 0-baseline: abs delta
+        flag = "REGRESSED" if r["regressed"] else "ok"
+        lines.append(f"{r['name']:<{width}}  {r['a']:>12.6g} -> "
+                     f"{r['b']:>12.6g}  {pct}  "
+                     f"({r['better']} is better)  {flag}")
+    n_reg = sum(r["regressed"] for r in rows)
+    lines.append(f"{n_reg} regression(s) out of {len(rows)} compared "
+                 f"metric(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """``python -m bigdl_tpu.telemetry diff`` entry (also callable from
+    bench.py)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="bigdl_tpu.telemetry diff",
+        description="compare two runs (JSONL run logs or bench.py JSON) "
+                    "and exit nonzero on a regression")
+    p.add_argument("run_a", help="baseline artifact")
+    p.add_argument("run_b", help="candidate artifact")
+    p.add_argument("--threshold-pct", type=float,
+                   default=DEFAULT_THRESHOLD_PCT,
+                   help="relative regression threshold for timing/"
+                        "throughput/MFU metrics (default %(default)s)")
+    p.add_argument("--count-slack", type=int, default=0,
+                   help="allowed increase for compile/retrace/health "
+                        "counts (default 0)")
+    p.add_argument("--json", action="store_true",
+                   help="emit rows as JSON instead of the table")
+    args = p.parse_args(argv)
+
+    try:
+        a = load_metrics(args.run_a)
+        b = load_metrics(args.run_b)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    rows = diff_metrics(a, b, threshold_pct=args.threshold_pct,
+                        count_slack=args.count_slack)
+    if args.json:
+        print(json.dumps({"a": a, "b": b, "rows": rows}, indent=2))
+    else:
+        print(format_diff(rows, a, b))
+    if not rows:
+        print("error: nothing comparable", file=sys.stderr)
+        return 2
+    return 1 if any(r["regressed"] for r in rows) else 0

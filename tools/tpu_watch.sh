@@ -30,6 +30,26 @@ if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
 fi
 echo $$ > "$PIDFILE"
 cd "$REPO"
+
+# Live-progress probe: when BIGDL_METRICS_PORT is set the benched
+# process serves a JSON /status endpoint (telemetry/metrics_http.py) —
+# poll THAT for step/loss/throughput instead of scraping its log files
+# (the log-scrape stays as the fallback when no port is configured).
+status_line() {
+  [ -z "${BIGDL_METRICS_PORT:-}" ] && return 1
+  python - "$BIGDL_METRICS_PORT" 2>/dev/null <<'PY'
+import json, sys, urllib.request
+port = sys.argv[1]
+st = json.load(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/status", timeout=2))
+step = st.get("step") or {}
+print(f"status: step={step.get('step', '?')} loss={step.get('loss', '?')} "
+      f"throughput={step.get('throughput', '?')} "
+      f"nonfinite={st.get('nonfinite_steps', 0)} "
+      f"compiles={st.get('compiles', 0)}")
+PY
+}
+
 while true; do
   ts=$(date -u +%H:%M:%S)
   # success = exit status of the probe process, NOT output matching:
@@ -57,7 +77,16 @@ print('OK', devs)
     mkdir -p "$REPO/bench_watch"
     [ -s "$REPO/bench_legs_r5.err" ] && \
       mv "$REPO/bench_legs_r5.err" "$REPO/bench_watch/legs_$(date -u +%m%d_%H%M).err"
-    timeout -k 30 14400 bash tools/run_legs_r5.sh >> "$LOG" 2>&1
+    # run the sweep in the background so the watcher can poll the live
+    # status endpoint (BIGDL_METRICS_PORT) while it works
+    timeout -k 30 14400 bash tools/run_legs_r5.sh >> "$LOG" 2>&1 &
+    sweep_pid=$!
+    while kill -0 "$sweep_pid" 2>/dev/null; do
+      line=$(status_line) && echo "$(date -u +%H:%M:%S) $line" >> "$LOG"
+      sleep 60 &
+      wait $! 2>/dev/null
+    done
+    wait "$sweep_pid"
     # NB: grep -c prints 0 itself on no-match (exit 1) — no || echo,
     # which would yield the two-line string "0\n0"
     banked=$(grep -c "^# .*images_per_sec" "$REPO/bench_legs_r5.err" 2>/dev/null); banked=${banked:-0}
