@@ -9,13 +9,17 @@ Usage::
     python -m bigdl_tpu.models.cli test   --model lenet  -f ./mnist \
         --checkpoint ./ckpt
     python -m bigdl_tpu.models.cli perf   --model inception_v1 -b 64 -i 10
+    python -m bigdl_tpu.models.cli summary   --model lenet
+    python -m bigdl_tpu.models.cli attribute --model transformer
 
 ``train`` runs the full Optimizer loop (validation every epoch, optional
 checkpointing and TensorBoard summaries, resume from snapshot);
 ``test`` reloads a checkpoint and evaluates Top1/Top5; ``perf`` is the
-LocalOptimizerPerf protocol (synthetic data, records/sec after warmup).
-Missing dataset folders fall back to synthetic data so every command is
-runnable anywhere.
+LocalOptimizerPerf protocol (synthetic data, records/sec after warmup);
+``summary`` prints the Torch-style per-layer table (path, output shape
+via eval_shape, params); ``attribute`` prints the per-module FLOPs/bytes
+cost table (docs/observability.md).  Missing dataset folders fall back
+to synthetic data so every command is runnable anywhere.
 """
 
 from __future__ import annotations
@@ -310,6 +314,29 @@ def cmd_perf(args) -> None:
           f"{wall:.2f}s)")
 
 
+def cmd_summary(args) -> None:
+    """Torch-style per-layer table over a registry model — reuses the
+    module-path machinery the cost attribution is built on."""
+    from bigdl_tpu.models.registry import input_spec
+
+    model = _build_model(args.model, args.num_classes)
+    print(model.summary(input_spec(args.model, args.batch_size)))
+
+
+def cmd_attribute(args) -> None:
+    """Per-module FLOPs/bytes table (telemetry/attribution.py)."""
+    import json
+
+    from bigdl_tpu.telemetry import attribution
+
+    result = attribution.attribute_model(
+        args.model, batch=args.batch_size, train=not args.forward)
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(attribution.format_attribution(result))
+
+
 def main(argv=None) -> None:
     # BEFORE any jax touch: a user-pinned JAX_PLATFORMS=cpu must win
     # over an externally-registered PJRT plugin (the axon sitecustomize
@@ -372,6 +399,22 @@ def main(argv=None) -> None:
     pf.add_argument("--bf16", action="store_true", default=True)
     pf.add_argument("--no-bf16", dest="bf16", action="store_false")
     pf.set_defaults(fn=cmd_perf)
+
+    sm = sub.add_parser("summary", help="Torch-style per-layer table "
+                                        "(shapes via eval_shape)")
+    common(sm)
+    sm.set_defaults(fn=cmd_summary)
+
+    at = sub.add_parser("attribute", help="per-module FLOPs/bytes cost "
+                                          "attribution table")
+    common(at)
+    at.add_argument("--forward", action="store_true",
+                    help="attribute the inference forward instead of "
+                         "the full train step")
+    at.add_argument("--json", action="store_true")
+    # same default batch as `python -m bigdl_tpu.telemetry attribute`:
+    # the two front-ends of one table must print the same numbers
+    at.set_defaults(fn=cmd_attribute, batch_size=8)
 
     args = p.parse_args(argv)
     if getattr(args, "telemetry", None):

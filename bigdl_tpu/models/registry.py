@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, NamedTuple
 
 __all__ = ["ModelEntry", "MODELS", "model_names", "build_model",
-           "input_spec"]
+           "input_spec", "train_pieces"]
 
 
 class ModelEntry(NamedTuple):
@@ -133,3 +133,39 @@ def input_spec(name: str, batch: int = 2):
         raise KeyError(f"unknown model {name!r}; choose from "
                        f"{model_names()}")
     return MODELS[name].spec(batch)
+
+
+#: models whose output is ClassNLL-compatible (log-probs over classes,
+#: integer labels).  A model in MODELS but not here (and not special-
+#: cased below) makes train_pieces return None — the attribution CLI
+#: then falls back to forward-only rather than lowering a nonsense step.
+_CLASSIFIERS = frozenset({
+    "lenet", "vgg16", "vgg19", "vgg_cifar", "inception_v1",
+    "inception_v2", "resnet", "resnet50", "lstm",
+})
+
+
+def train_pieces(name: str, batch: int = 2):
+    """``(criterion, target ShapeDtypeStruct)`` for training this model
+    on synthetic specs — what the cost-attribution CLI needs to lower a
+    full TrainStep without data (``telemetry/attribution.py``).  Returns
+    None for models the table doesn't know how to train."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+
+    if name not in MODELS:
+        raise KeyError(f"unknown model {name!r}; choose from "
+                       f"{model_names()}")
+    if name == "autoencoder":
+        return (nn.MSECriterion(),
+                jax.ShapeDtypeStruct((batch, 28 * 28), jnp.float32))
+    if name == "transformer":
+        return (nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                            size_average=True),
+                jax.ShapeDtypeStruct((batch, LM_SEQ_LEN), jnp.int32))
+    if name in _CLASSIFIERS:
+        return (nn.ClassNLLCriterion(),
+                jax.ShapeDtypeStruct((batch,), jnp.int32))
+    return None

@@ -4,6 +4,7 @@ SURVEY §2.4-§2.5), re-designed for JAX."""
 from bigdl_tpu.nn.module import (  # noqa: F401
     Module, Parameter, Container, Sequential, Identity, Echo,
     LayerException, functional_call, state_dict, load_state_dict,
+    stamp_scope_names, capture_shapes, summary,
 )
 from bigdl_tpu.nn import init  # noqa: F401
 from bigdl_tpu.nn.criterion import *  # noqa: F401,F403

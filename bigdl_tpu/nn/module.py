@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import copy
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -43,6 +44,9 @@ __all__ = [
     "functional_call",
     "state_dict",
     "load_state_dict",
+    "stamp_scope_names",
+    "capture_shapes",
+    "summary",
 ]
 
 
@@ -162,6 +166,18 @@ class Module:
         import jax as _jax
 
         t0 = time.perf_counter()
+        # cost-attribution scope (docs/observability.md): once a model is
+        # stamped (stamp_scope_names — TrainStep/EvalStep do it at build
+        # time), every module runs its computation under
+        # jax.named_scope(<registration key>), so compiled-HLO op metadata
+        # carries the module-tree path.  Scopes are trace-time metadata
+        # only: they never enter jit cache keys, so no retraces.
+        scope = self.__dict__.get("_scope_name")
+        run = self.update_output
+        if scope:
+            def run(inp, _run=self.update_output, _scope=scope):
+                with _jax.named_scope(_scope):
+                    return _run(inp)
         try:
             if current_rng_key() is None:
                 # Eager call outside any training-step RNG context: install a
@@ -170,15 +186,21 @@ class Module:
                 key = _jax.random.key(int(RNG.randint(0, 2**31 - 1)))
                 self.__dict__["_last_rng_key"] = key
                 with rng_context(key):
-                    out = self.update_output(input)
+                    out = run(input)
             else:
-                out = self.update_output(input)
+                out = run(input)
         except jax.errors.TracerArrayConversionError:
             raise
         except LayerException:
             raise
         except Exception as e:  # noqa: BLE001 - parity with LayerException wrap
             raise LayerException(self.get_name(), e) from e
+        if _SHAPE_CAPTURE:
+            # record ABSTRACT shapes only (never the tracers themselves):
+            # the capture outlives the trace that produced it
+            _SHAPE_CAPTURE[-1][id(self)] = jax.tree.map(
+                lambda a: (tuple(jnp.shape(a)),
+                           str(getattr(a, "dtype", type(a).__name__))), out)
         self.__dict__["output"] = out
         self.__dict__["forward_time"] += time.perf_counter() - t0
         return out
@@ -415,6 +437,12 @@ class Module:
                 return m
         raise KeyError(name)
 
+    def summary(self, input_spec=None) -> str:
+        """Torch-style per-layer table (path, class, output shape via
+        ``jax.eval_shape``, param count/bytes) — see
+        :func:`bigdl_tpu.nn.module.summary`."""
+        return summary(self, input_spec)
+
     # -- prediction / evaluation (single-process convenience) -------------
     def predict(self, dataset, batch_size: int = 32):
         from bigdl_tpu.optim.predictor import LocalPredictor
@@ -430,6 +458,116 @@ class Module:
         from bigdl_tpu.optim.evaluator import Evaluator
 
         return Evaluator(self, batch_size=batch_size).evaluate(dataset, methods)
+
+
+# --------------------------------------------------------------------------
+# Module paths: cost-attribution scopes + shape capture + summary
+# --------------------------------------------------------------------------
+
+#: stack of active shape-capture dicts (id(module) -> output shape pytree);
+#: a plain module global so Module.forward pays one falsy check when off.
+_SHAPE_CAPTURE: List[Dict[int, Any]] = []
+
+
+def stamp_scope_names(root: Module, enabled: bool = True) -> Module:
+    """Stamp every submodule with its registration key so
+    :meth:`Module.forward` wraps its computation in
+    ``jax.named_scope(<key>)`` — nesting reproduces the full module path
+    (``features/0/conv1``) in compiled-HLO op metadata, the substrate of
+    per-module cost attribution (``telemetry/attribution.py``).
+
+    Labels are the ``_modules`` registration keys, so a scope path joined
+    with ``.`` equals the ``named_parameters`` path of the same module.
+    The root carries no scope (its children are the first frame).  A
+    weight-shared module registered under several paths keeps the first
+    label — its usages aggregate under one row.  ``enabled=False`` clears
+    the stamps (``BIGDL_SCOPES=off``)."""
+    seen = {id(root)}
+    for name, m in root.named_modules():
+        if not name:
+            continue
+        if not enabled:
+            m.__dict__.pop("_scope_name", None)
+            continue
+        if id(m) in seen:  # weight sharing: first path wins
+            continue
+        seen.add(id(m))
+        # __dict__ write, NOT __setattr__: stamping must not bump
+        # _hyper_version (that would invalidate memoized backward traces)
+        m.__dict__["_scope_name"] = name.rsplit(".", 1)[-1]
+    return root
+
+
+@contextmanager
+def capture_shapes():
+    """Collect each module's output shapes during the forwards run inside
+    the block — yields ``{id(module): pytree of (shape, dtype)}``.  Safe
+    under ``jax.eval_shape``: only abstract shapes are stored."""
+    cap: Dict[int, Any] = {}
+    _SHAPE_CAPTURE.append(cap)
+    try:
+        yield cap
+    finally:
+        # remove by IDENTITY: list.remove uses ==, and two empty capture
+        # dicts compare equal — equality removal could strip another
+        # active capture's dict under concurrency/nesting
+        for i in range(len(_SHAPE_CAPTURE) - 1, -1, -1):
+            if _SHAPE_CAPTURE[i] is cap:
+                del _SHAPE_CAPTURE[i]
+                break
+
+
+def summary(module: Module, input_spec=None) -> str:
+    """Torch-style per-layer table: module path, class, output shape,
+    own-parameter count/bytes, trainable flag.
+
+    ``input_spec``: a (pytree of) ``jax.ShapeDtypeStruct`` (or concrete
+    arrays) fed through ``jax.eval_shape`` — no data, no compile.  When
+    omitted the output-shape column is skipped (parameters only).
+
+    The table needs no scope stamping (shape capture keys on module
+    identity), so a ``BIGDL_SCOPES=off`` choice is left untouched."""
+    shapes: Dict[int, Any] = {}
+    if input_spec is not None:
+        state = state_dict(module)
+
+        def fwd(x):
+            return functional_call(module, state, x, training=False)[0]
+
+        with capture_shapes() as shapes:
+            jax.eval_shape(fwd, input_spec)
+
+    def _fmt_shape(tree) -> str:
+        leaves = jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, tuple) and len(x) == 2
+            and isinstance(x[0], tuple) and isinstance(x[1], str))
+        return ", ".join(f"{list(s)} {d}" for s, d in leaves) or "?"
+
+    rows = []
+    total_params = total_bytes = 0
+    for name, m in module.named_modules():
+        own = m.__dict__["_params"]
+        n_params = sum(int(np.prod(p.shape)) if p.ndim else 1
+                       for p in own.values())
+        n_bytes = sum(int(getattr(p, "nbytes", 0)) for p in own.values())
+        total_params += n_params
+        total_bytes += n_bytes
+        rows.append((name or "(root)", type(m).__name__,
+                     _fmt_shape(shapes.get(id(m))) if shapes else "-",
+                     n_params, n_bytes,
+                     "frozen" if m.__dict__["_frozen"] else "train"))
+    widths = [max(len(str(r[i])) for r in rows) for i in range(3)]
+    lines = [f"{'module':<{widths[0]}}  {'class':<{widths[1]}}  "
+             f"{'output shape':<{widths[2]}}  {'params':>10}  "
+             f"{'bytes':>12}  mode"]
+    lines.append("-" * len(lines[0]))
+    for path, cls, shape, n, b, mode in rows:
+        lines.append(f"{path:<{widths[0]}}  {cls:<{widths[1]}}  "
+                     f"{shape:<{widths[2]}}  {n:>10}  {b:>12}  {mode}")
+    lines.append("-" * len(lines[0]))
+    lines.append(f"total parameters: {total_params:,}  "
+                 f"({total_bytes:,} bytes)")
+    return "\n".join(lines)
 
 
 # --------------------------------------------------------------------------

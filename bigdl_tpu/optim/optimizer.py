@@ -590,12 +590,16 @@ class Optimizer:
                 try:
                     return self._optimize_once()
                 except KeyboardInterrupt:
+                    self._flight_dump("keyboard_interrupt")
                     raise
-                except HealthError:
+                except HealthError as e:
                     # a policy halt is a VERDICT, not a failure — the
                     # model is diverged and a checkpoint restore would
                     # just replay the divergence; never burn the retry
-                    # budget on it
+                    # budget on it.  The flight recorder dumps the final
+                    # steps' events + the halting evidence for the
+                    # postmortem.
+                    self._flight_dump("health_halt", e.evidence)
                     raise
                 except Exception as e:  # noqa: BLE001 — retry loop parity
                     now = time.time()
@@ -604,8 +608,15 @@ class Optimizer:
                                       message=str(e)[:200],
                                       attempt=len(failures),
                                       budget=retry_times)
+                    if isinstance(e, StragglerTimeout):
+                        # each firing gets its own dump: the ring holds
+                        # the steps LEADING INTO the stall, which a
+                        # post-restore log can no longer show
+                        self._flight_dump("straggler_timeout")
                     if len(failures) > retry_times:
                         log.error(f"retry budget exhausted ({retry_times} in {retry_window}s)")
+                        self._flight_dump(
+                            f"retry_exhausted:{type(e).__name__}")
                         raise
                     log.warning(f"training failed with {type(e).__name__}: {e}; "
                                 f"retry {len(failures)}/{retry_times}")
@@ -613,6 +624,20 @@ class Optimizer:
                         log.warning("no checkpoint to restore; restarting from current weights")
         finally:
             self._telemetry_end()
+
+    def _flight_dump(self, reason: str, evidence: Optional[Dict] = None):
+        """Dump the flight recorder (telemetry/flight.py) on the way out
+        of a dying run — called BEFORE _telemetry_end so the recorder is
+        still attached.  Never raises: the run is already dying."""
+        recorder = telemetry.flight_recorder()
+        if recorder is None:
+            return
+        try:
+            path = recorder.dump(reason, evidence)
+            if path:
+                log.info(f"[Flight] recorder dumped to {path}")
+        except Exception:  # noqa: BLE001 - a dying run must not die harder
+            pass
 
     def _resolve_health_policy(self) -> Optional[HealthPolicy]:
         policy = self._health_policy
@@ -678,12 +703,23 @@ class Optimizer:
             if prefetch_depth > 0 else None
         epoch_start = time.perf_counter()
 
-        # profiler hook: BIGDL_PROFILE=<dir> traces the first
-        # BIGDL_PROFILE_ITERS iterations (jax.profiler, op-level timings)
+        # on-demand profiler (telemetry/profiler.py): the loop polls one
+        # process-wide control each iteration, so a capture can be armed
+        # at ANY step — POST /profile on the live endpoint, the health
+        # policy's escalation hook, or BIGDL_PROFILE, which now merely
+        # pre-arms the same control with the first N iterations
         cfg = get_config()
-        profile_dir = cfg.profile_dir
-        profile_iters = cfg.profile_iters
-        profiling = False
+        from bigdl_tpu.telemetry import profiler as _profiler
+
+        profile_ctl = _profiler.get()
+        if cfg.profile_dir and cfg.profile_iters > 0:
+            profile_ctl.arm(cfg.profile_iters, cfg.profile_dir,
+                            source="startup")
+        # BIGDL_PROFILE_ON_HEALTH is one-shot PER RUN ATTEMPT: without
+        # this latch a chronic warn-level finding would re-arm after
+        # every completed capture and keep the profiler on for the rest
+        # of the (sick, already slow) run
+        self._health_profile_armed = False
         first_iteration = True
 
         log.info(f"[Optimizer] start training to {mesh} "
@@ -692,9 +728,7 @@ class Optimizer:
         tele_base = tele.depth() if tele else 0
         try:
             while not self.end_when(self.state):
-                if profile_dir and not profiling and profile_iters > 0:
-                    jax.profiler.start_trace(profile_dir)
-                    profiling = True
+                profile_ctl.poll_begin()
                 t_start = time.perf_counter()
                 it_sid = tele.begin("train/iteration",
                                     step=self.state["neval"] + 1) \
@@ -748,13 +782,7 @@ class Optimizer:
                                  sync_s)
                 first_iteration = False
                 t_end = time.perf_counter()
-                if profiling:
-                    profile_iters -= 1
-                    if profile_iters <= 0:
-                        jax.profiler.stop_trace()
-                        profiling = False
-                        log.info(
-                            f"[Optimizer] profiler trace in {profile_dir}")
+                profile_ctl.poll_end()
                 n = batch_n * record_scale  # global records this iteration
                 self.state["neval"] += 1
                 self.state["loss"] = loss
@@ -845,9 +873,9 @@ class Optimizer:
         finally:
             if prefetcher is not None:
                 prefetcher.close()
-            if profiling:
-                jax.profiler.stop_trace()
-                log.info(f"[Optimizer] profiler trace in {profile_dir}")
+            # an in-flight capture is closed (valid trace), a merely
+            # armed one cancelled — the control is reusable next run
+            profile_ctl.abort()
         step.sync_to_model()
         self._join_checkpoint_write()  # run ends with all writes landed
         log.info(self.metrics.summary())
@@ -884,6 +912,20 @@ class Optimizer:
                     ts.add_scalar(f"health/{key}", stats[key], n)
         if action == "ok":
             return
+        # BIGDL_PROFILE_ON_HEALTH=<dir>: the FIRST escalation arms a
+        # one-shot profiler capture so the NEXT step — the divergence
+        # itself, not a healthy step hours earlier — gets traced.
+        # Latched per run attempt: later findings never re-arm.
+        on_health = get_config().profile_on_health
+        if on_health and action != "halt" \
+                and not getattr(self, "_health_profile_armed", True):
+            from bigdl_tpu.telemetry import profiler as _profiler
+
+            ctl = _profiler.get()
+            base = None if on_health.lower() in ("1", "true", "on", "yes") \
+                else on_health
+            if ctl.arm(1, ctl.default_dir(base), source="health"):
+                self._health_profile_armed = True
         names = ", ".join(name for name, _ in findings)
         log.warning(f"[Health] step {n}: {names} "
                     f"(loss={stats['loss']:.4g}, "

@@ -158,6 +158,14 @@ class TrainStep:
         self.skip_nonfinite = skip_nonfinite
         self.last_health = None  # device [5] vector, see PROBE_FIELDS
 
+        # module-path scopes (docs/observability.md): stamped before the
+        # first trace so compiled-HLO op metadata carries the module tree
+        # — the substrate of per-module cost attribution.  Trace-time
+        # metadata only; jit cache keys are unchanged (zero retraces).
+        from bigdl_tpu.nn.module import stamp_scope_names
+        from bigdl_tpu.utils.config import get_config
+
+        stamp_scope_names(model, enabled=get_config().module_scopes)
         self.params = state_dict(model, kind="param")
         self.buffers = state_dict(model, kind="buffer")
         self.opt_state = optim_method.init_state(self.params)
@@ -459,7 +467,8 @@ class TrainStep:
         from bigdl_tpu.telemetry import device as _tdev
         from bigdl_tpu.utils.config import get_config
 
-        level = get_config().telemetry_device
+        cfg = get_config()
+        level = cfg.telemetry_device
         if level == "off":
             return
         try:
@@ -472,6 +481,17 @@ class TrainStep:
             return
         if facts:
             tracer.emit("device_facts", facts=facts)
+        if cfg.telemetry_attribution and cfg.module_scopes:
+            # per-module cost rows from the SAME lowered program — a
+            # StableHLO text parse, no extra XLA compile
+            try:
+                from bigdl_tpu.telemetry import attribution as _attr
+
+                payload = _attr.attribute_lowered(lowered, self.model)
+                payload["program"] = "train_step"
+                tracer.emit("attribution", **payload)
+            except Exception:  # noqa: BLE001 - attribution is an observer
+                pass
 
     def _shard_batch(self, x, y, stacked: bool = False):
         if self.mesh is None:
@@ -585,6 +605,10 @@ class EvalStep:
 
     def __init__(self, model: Module, mesh=None, batch_axes=(DATA_AXIS,),
                  compute_dtype=None):
+        from bigdl_tpu.nn.module import stamp_scope_names
+        from bigdl_tpu.utils.config import get_config
+
+        stamp_scope_names(model, enabled=get_config().module_scopes)
         self.model = model
         self.mesh = mesh
         self.batch_axes = tuple(batch_axes)

@@ -32,12 +32,13 @@ from bigdl_tpu.telemetry.tracer import (SCHEMA_VERSION, JsonlSink,
 
 __all__ = ["SCHEMA_VERSION", "Tracer", "JsonlSink", "MemorySink",
            "enabled", "get", "start_run", "end_run", "run", "maybe_run",
-           "last_run_path", "metrics_server", "span", "stage", "counter",
-           "gauge", "instant", "emit"]
+           "last_run_path", "metrics_server", "flight_recorder", "span",
+           "stage", "counter", "gauge", "instant", "emit"]
 
 _active: Optional[Tracer] = None
 _last_run_path: Optional[str] = None
 _metrics_server = None
+_flight = None
 _lifecycle_lock = threading.Lock()
 
 
@@ -65,6 +66,14 @@ def metrics_server():
     return _metrics_server
 
 
+def flight_recorder():
+    """The crash flight recorder bound to the active run, or None
+    (``BIGDL_FLIGHT=0`` / no run active).  ``.dump(reason)`` writes the
+    ring to a ``flight-<stamp>.json``; the Optimizer calls it on
+    HealthError, straggler firings, and crashes."""
+    return _flight
+
+
 def _default_meta() -> Dict[str, Any]:
     meta: Dict[str, Any] = {"schema": SCHEMA_VERSION}
     try:  # device facts are best-effort: telemetry must work sans jax
@@ -88,7 +97,7 @@ def start_run(path_or_dir: Optional[str] = None,
     ``run-<stamp>-<pid>.jsonl``; None writes to no file (pass ``sinks``,
     e.g. a MemorySink, instead).  Raises if a run is already active —
     nested runs would interleave two schedules into one file."""
-    global _active, _last_run_path, _metrics_server
+    global _active, _last_run_path, _metrics_server, _flight
     with _lifecycle_lock:
         if _active is not None:
             raise RuntimeError("a telemetry run is already active; "
@@ -106,11 +115,30 @@ def start_run(path_or_dir: Optional[str] = None,
                     f"run-{stamp}-p{pidx}-{os.getpid()}.jsonl")
             all_sinks.append(JsonlSink(path))
             _last_run_path = path
+        _flight = _maybe_flight()
+        if _flight is not None:
+            all_sinks.append(_flight)
         tracer = Tracer(sinks=all_sinks, meta=full_meta)
         tracer.start()
         _active = tracer
         _metrics_server = _maybe_serve_metrics(tracer)
         return tracer
+
+
+def _maybe_flight():
+    """A FlightRecorder sink sized by ``BIGDL_FLIGHT`` (default 2048
+    events; 0 disables)."""
+    from bigdl_tpu.utils.config import get_config
+
+    capacity = get_config().flight_events
+    if capacity <= 0:
+        return None
+    try:
+        from bigdl_tpu.telemetry.flight import FlightRecorder
+
+        return FlightRecorder(capacity)
+    except Exception:  # noqa: BLE001 - observers never kill the run
+        return None
 
 
 def _maybe_serve_metrics(tracer):
@@ -139,10 +167,11 @@ def _maybe_serve_metrics(tracer):
 def end_run() -> None:
     """Close the active run (flushes and closes sinks, stops the metrics
     endpoint); no-op when no run is active."""
-    global _active, _metrics_server
+    global _active, _metrics_server, _flight
     with _lifecycle_lock:
         tracer, _active = _active, None
         server, _metrics_server = _metrics_server, None
+        _flight = None
     if server is not None:
         try:
             server.stop()

@@ -13,11 +13,16 @@ Options::
     python -m bigdl_tpu.telemetry p0.jsonl p1.jsonl ...      # fleet view
     python -m bigdl_tpu.telemetry diff old.jsonl new.jsonl   # regression
     python -m bigdl_tpu.telemetry diff old_bench.json new_bench.json
+    python -m bigdl_tpu.telemetry attribute --model lenet    # per-module cost
+    python -m bigdl_tpu.telemetry attribute run.jsonl        # from a run log
 
 Passing several run logs merges them into the multi-host fleet view
 (per-process step progress + step-skew).  ``diff`` compares two runs
 (JSONL logs or bench.py JSON, mixed freely) and exits nonzero when the
-candidate regressed beyond the thresholds — the CI gate.
+candidate regressed beyond the thresholds — the CI gate.  ``attribute``
+prints the per-module FLOPs/bytes table — computed fresh for a registry
+model (``--model``, CPU-friendly: lower + parse, no run needed) or read
+back from a run log's ``attribution`` event.
 """
 
 from __future__ import annotations
@@ -32,17 +37,63 @@ from bigdl_tpu.telemetry.report import (fleet_summarize, format_fleet,
                                         format_summary, summarize)
 
 
+def attribute_main(argv) -> int:
+    """``python -m bigdl_tpu.telemetry attribute`` entry (also backs the
+    ``models/cli.py attribute`` subcommand)."""
+    import argparse
+
+    from bigdl_tpu.telemetry import attribution
+
+    p = argparse.ArgumentParser(
+        prog="bigdl_tpu.telemetry attribute",
+        description="per-module FLOPs/bytes attribution table")
+    p.add_argument("run", nargs="?", default=None, metavar="run.jsonl",
+                   help="read the attribution event back from a run log "
+                        "(recorded with BIGDL_ATTRIBUTION=1)")
+    p.add_argument("--model", default=None,
+                   help="compute fresh for a registry model instead")
+    p.add_argument("-b", "--batch", type=int, default=8)
+    p.add_argument("--forward", action="store_true",
+                   help="attribute the inference forward instead of the "
+                        "full train step")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if (args.run is None) == (args.model is None):
+        p.error("pass exactly one of run.jsonl or --model NAME")
+    if args.model is not None:
+        result = attribution.attribute_model(
+            args.model, batch=args.batch, train=not args.forward)
+    else:
+        events, parse_errors = schema.read_events(args.run)
+        for e in parse_errors:
+            print(f"warning: {args.run}: {e}", file=sys.stderr)
+        result = attribution.rows_from_events(events)
+        if result is None:
+            print(f"error: {args.run} has no attribution event (record "
+                  f"with BIGDL_ATTRIBUTION=1, or use --model)",
+                  file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(attribution.format_attribution(result))
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "diff":
         from bigdl_tpu.telemetry import diff as diff_mod
 
         return diff_mod.main(argv[1:])
+    if argv and argv[0] == "attribute":
+        return attribute_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="bigdl_tpu.telemetry",
         description="summarize / compare / export telemetry run logs "
-                    "(subcommand: diff <runA> <runB>)")
+                    "(subcommands: diff <runA> <runB>, attribute "
+                    "[run.jsonl | --model NAME])")
     p.add_argument("runs", nargs="+", metavar="run.jsonl",
                    help="path(s) to run-*.jsonl event logs; several "
                         "merge into the fleet view")

@@ -219,11 +219,21 @@ def main(argv=None) -> int:
         return 2
     rows = diff_metrics(a, b, threshold_pct=args.threshold_pct,
                         count_slack=args.count_slack)
+    n_regressed = sum(r["regressed"] for r in rows)
+    exit_code = 2 if not rows else (1 if n_regressed else 0)
     if args.json:
-        print(json.dumps({"a": a, "b": b, "rows": rows}, indent=2))
+        # CI-consumable: the verdict and exit code travel IN the
+        # payload, so a pipeline can archive one artifact and decide
+        # later without re-running (exit-code contract unchanged)
+        verdict = {0: "ok", 1: "regressed", 2: "not_comparable"}[exit_code]
+        print(json.dumps({"a": a, "b": b, "rows": rows,
+                          "verdict": verdict, "regressions": n_regressed,
+                          "compared": len(rows),
+                          "threshold_pct": args.threshold_pct,
+                          "count_slack": args.count_slack,
+                          "exit_code": exit_code}, indent=2))
     else:
         print(format_diff(rows, a, b))
     if not rows:
         print("error: nothing comparable", file=sys.stderr)
-        return 2
-    return 1 if any(r["regressed"] for r in rows) else 0
+    return exit_code

@@ -1,0 +1,107 @@
+"""Crash flight recorder: a bounded ring of the most recent telemetry
+events plus the last health evidence, dumped to ``flight-<stamp>.json``
+when training dies — ``HealthError`` halt, straggler firing, retry
+exhaustion, or any crash escaping ``optimize()``.
+
+The recorder is a tracer sink (attached by ``telemetry.start_run``
+whenever ``BIGDL_FLIGHT`` > 0, the default), so it costs one deque
+append per event while healthy and needs no log file to exist: the dump
+is self-contained postmortem evidence even when the JSONL sink was
+disabled or its tail lost to a hard crash.
+
+Dump layout::
+
+    {"reason": "...", "dumped_at": <epoch>, "meta": {...},
+     "evidence": {...},            # HealthError evidence, if any
+     "last_health": {...},         # most recent health probe event
+     "events": [...]}              # the ring, oldest first
+
+``python -m json.tool flight-*.json`` is all a postmortem needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    """Tracer sink keeping the last ``capacity`` events in memory."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring: "deque" = deque(maxlen=max(self.capacity, 1))
+        self._last_health: Dict[str, Any] = {}
+        self.meta: Dict[str, Any] = {}
+        self.last_dump_path: Optional[str] = None
+        self.dumps = 0
+
+    # -- sink protocol -----------------------------------------------------
+    def emit(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            kind = event.get("kind")
+            if kind == "run_start":
+                self.meta.update(event.get("meta") or {})
+            elif kind == "health":
+                self._last_health = event
+            self._ring.append(event)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- the dump ----------------------------------------------------------
+    def dump(self, reason: str, evidence: Optional[Dict[str, Any]] = None,
+             directory: Optional[str] = None) -> Optional[str]:
+        """Write the ring to ``flight-<stamp>.json`` and return its path
+        (None when the write itself fails — a dying process must not die
+        harder).  ``directory`` defaults to the telemetry dir, else the
+        cwd."""
+        if directory is None:
+            from bigdl_tpu.utils.config import get_config
+
+            directory = get_config().telemetry_dir or "."
+        with self._lock:
+            events: List[Dict[str, Any]] = list(self._ring)
+            payload = {"reason": reason,
+                       "dumped_at": time.time(),
+                       "pid": os.getpid(),
+                       "meta": dict(self.meta),
+                       "evidence": dict(evidence or {}),
+                       "last_health": dict(self._last_health),
+                       "events": events}
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        with self._lock:
+            seq = self.dumps  # two dumps in one second must not collide
+        path = os.path.join(
+            directory, f"flight-{stamp}-{os.getpid()}-{seq}.json")
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, default=repr)
+            with self._lock:
+                self.last_dump_path = path
+                self.dumps += 1
+        except Exception:  # noqa: BLE001 - dumping is best-effort
+            return None
+        from bigdl_tpu import telemetry
+
+        telemetry.instant("flight/dump", path=path, reason=reason,
+                          events=len(events))
+        return path
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "events_buffered": len(self._ring),
+                    "dumps": self.dumps,
+                    "last_dump_path": self.last_dump_path}

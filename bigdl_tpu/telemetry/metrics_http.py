@@ -11,7 +11,14 @@ counts).  A stdlib ``ThreadingHTTPServer`` on a daemon thread serves it:
   labelled with ``process_index`` so a multi-host fleet scrapes into one
   Prometheus without series collisions;
 - ``GET /status``   — the same state as one JSON object (per-process
-  step progress for ``tools/tpu_watch.sh`` and humans with curl);
+  step progress for ``tools/tpu_watch.sh`` and humans with curl), plus
+  the on-demand profiler state (armed / capturing / last trace dir) and
+  the flight-recorder state (ring fill, last dump path);
+- ``POST /profile?steps=N`` — arm an on-demand ``jax.profiler`` capture
+  of the next N training iterations (``telemetry/profiler.py``); the
+  optimizer loop starts/stops the trace, training never blocks.  409
+  when a capture is already armed or running; optional ``dir=<path>``
+  overrides the trace directory;
 - ``GET /healthz``  — liveness (always 200 while the run is alive).
 
 Enabled by ``BIGDL_METRICS_PORT`` (or ``--metrics-port`` on
@@ -172,6 +179,26 @@ class MetricsSink:
             return "\n".join(lines) + "\n"
 
 
+def _observer_status() -> Dict[str, Any]:
+    """Profiler + flight-recorder state for /status (process-wide
+    singletons, not per-sink state)."""
+    out: Dict[str, Any] = {}
+    try:
+        from bigdl_tpu.telemetry import profiler
+
+        out["profiler"] = profiler.get().status()
+    except Exception:  # noqa: BLE001 - status is best-effort
+        pass
+    try:
+        from bigdl_tpu import telemetry
+
+        fr = telemetry.flight_recorder()
+        out["flight"] = fr.status() if fr is not None else None
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the sink is attached to the server object by start_server
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
@@ -182,7 +209,9 @@ class _Handler(BaseHTTPRequestHandler):
                 body = sink.openmetrics().encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path in ("/", "/status"):
-                body = (json.dumps(sink.status(), default=str) + "\n"
+                status = sink.status()
+                status.update(_observer_status())
+                body = (json.dumps(status, default=str) + "\n"
                         ).encode("utf-8")
                 ctype = "application/json"
             elif path == "/healthz":
@@ -191,16 +220,59 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self.send_error(404)
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._respond(200, body, ctype)
         except Exception:  # noqa: BLE001 - observers never kill the run
             try:
                 self.send_error(500)
             except Exception:  # noqa: BLE001 - client already gone
                 pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        """``POST /profile?steps=N[&dir=...]`` — arm an on-demand
+        profiler capture; the training loop does the rest."""
+        try:
+            from urllib.parse import parse_qs, urlparse
+
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/") or "/"
+            if path != "/profile":
+                self.send_error(404)
+                return
+            from bigdl_tpu.telemetry import profiler
+
+            control = profiler.get()
+            query = parse_qs(parsed.query)
+            try:
+                steps = int(query.get("steps", ["5"])[0])
+            except ValueError:
+                steps = 0
+            trace_dir = query.get("dir", [None])[0] \
+                or control.default_dir()
+            if steps < 1:
+                body = json.dumps({"armed": False,
+                                   "error": "steps must be >= 1"})
+                self._respond(400, (body + "\n").encode("utf-8"),
+                              "application/json")
+                return
+            armed = control.arm(steps, trace_dir, source="http")
+            payload = {"armed": armed, **control.status()}
+            if not armed:
+                payload["error"] = "a capture is already armed or running"
+            self._respond(200 if armed else 409,
+                          (json.dumps(payload, default=str) + "\n"
+                           ).encode("utf-8"), "application/json")
+        except Exception:  # noqa: BLE001 - observers never kill the run
+            try:
+                self.send_error(500)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def log_message(self, *args) -> None:  # silence per-request stderr spam
         pass

@@ -35,6 +35,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     counters: Dict[str, Dict[str, float]] = {}
     gauges: Dict[str, Dict[str, float]] = {}
     facts: Dict[str, Any] = {}
+    attribution: Optional[Dict[str, Any]] = None
     health: Dict[str, Any] = {"probes": 0, "nonfinite_steps": 0,
                               "events": {}, "last": {}}
     t0 = t1 = None
@@ -91,6 +92,9 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             row["last"] = v
         elif kind == "device_facts":
             facts.update(ev.get("facts") or {})
+        elif kind == "attribution":
+            attribution = {k: v for k, v in ev.items()
+                           if k not in ("v", "ts", "pid", "tid", "kind")}
 
     for row in stages.values():
         row["mean_s"] = row["total_s"] / row["n"] if row["n"] else 0.0
@@ -126,7 +130,8 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "stages": stages, "steps": step_stats,
             "compiles": compiles, "retraces": retraces,
             "events": instants, "counters": counters, "gauges": gauges,
-            "device_facts": facts, "mfu": mfu, "health": health}
+            "device_facts": facts, "mfu": mfu, "health": health,
+            "attribution": attribution}
 
 
 def _fmt_bytes(n: float) -> str:
@@ -245,6 +250,22 @@ def format_summary(summary: Dict[str, Any],
             lines.append(f"{name:<{width}}  last {row['last']:g}  "
                          f"min {row['min']:g}  max {row['max']:g}  "
                          f"n={int(row['n'])}")
+
+    attribution = summary.get("attribution")
+    if attribution and attribution.get("rows"):
+        rows = [r for r in attribution["rows"] if r.get("flops")]
+        rows.sort(key=lambda r: -r["flops"])
+        total = attribution.get("total_flops") or \
+            sum(r["flops"] for r in rows) or 1.0
+        lines.append("")
+        lines.append("-- per-module cost (top 10 by flops; full table: "
+                     "telemetry attribute) --")
+        width = max((len(r["path"]) for r in rows[:10]), default=6)
+        for r in rows[:10]:
+            lines.append(f"{r['path']:<{width}}  "
+                         f"{r['flops']/1e9:9.3f} GF  "
+                         f"{r['flops']/total*100:5.1f}%  "
+                         f"{r.get('class', '')}")
 
     health = summary.get("health") or {}
     if health.get("probes"):

@@ -23,8 +23,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Iterable, List, Tuple
 
-__all__ = ["KINDS", "validate_event", "validate_events", "validate_run",
-           "read_events"]
+__all__ = ["KINDS", "STREAM_NAMES", "validate_event", "validate_events",
+           "validate_run", "read_events"]
 
 _NUM = (int, float)
 
@@ -46,10 +46,42 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # one per probed training step: grad/param/update norms + nonfinite
     # counts (telemetry/health.py PROBE_FIELDS travel as extra fields)
     "health": {"step": (int,)},
+    # per-module cost attribution (telemetry/attribution.py): rows is a
+    # list of {path, class, flops, flops_fwd, flops_bwd, bytes, params}
+    "attribution": {"rows": (list,)},
 }
 
 _BASE: Dict[str, tuple] = {"v": (int,), "ts": _NUM, "pid": (int,),
                            "tid": (int,), "kind": (str,)}
+
+#: every span/stage/counter/gauge/instant name the framework emits,
+#: plus the compile-event names.  ``tests/test_schema_registry.py``
+#: greps the sources for emitted literals and asserts membership here,
+#: so a new event stream cannot silently bypass ``--validate`` and the
+#: readers (report/diff/metrics_http) that key off names.
+STREAM_NAMES = frozenset({
+    # spans
+    "train/iteration", "data_wait", "validation", "checkpoint",
+    "perf/warmup", "perf/timed", "profile/trace", "profile/warmup",
+    # instants
+    "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
+    "metrics/serving", "profile/armed", "profile/captured",
+    "flight/dump",
+    # health findings (telemetry/health.py detectors + policy)
+    "health/nonfinite", "health/skip", "health/loss_spike",
+    "health/plateau", "health/grad_explosion", "health/halt",
+    # counters / gauges
+    "perf/records_per_sec", "prefetch/queue_depth",
+    # pipeline stages (optim.Metrics forwarding + bench.py)
+    "host to device time", "host to device time (overlapped)",
+    "dispatch time", "computing time",
+    "compile + first iteration time", "data time", "validation time",
+    "checkpoint time", "checkpoint wait time", "h2d", "dispatch",
+    "device",
+    # compile-event names (TrainStep/EvalStep dispatch kinds)
+    "TrainStep.run", "TrainStep.run_sharded", "TrainStep.run_scan",
+    "TrainStep.aot_scan", "EvalStep.run",
+})
 
 
 def validate_event(event: Dict[str, Any]) -> List[str]:

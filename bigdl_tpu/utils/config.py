@@ -26,6 +26,10 @@ test (or an embedding application) can inject overrides with
 | profile_iters          | BIGDL_PROFILE_ITERS         | profiler hook |
 | telemetry_dir          | BIGDL_TELEMETRY             | telemetry run log dir (docs/observability.md) |
 | telemetry_device       | BIGDL_TELEMETRY_DEVICE      | device-facts level: off / auto / full |
+| module_scopes          | BIGDL_SCOPES                | jax.named_scope module paths in compiled HLO (default on; off disables attribution) |
+| telemetry_attribution  | BIGDL_ATTRIBUTION           | emit per-module cost-attribution events (one re-lower + HLO parse per step object) |
+| flight_events          | BIGDL_FLIGHT                | crash flight-recorder ring capacity in events (0 = off) |
+| profile_on_health      | BIGDL_PROFILE_ON_HEALTH     | arm a one-shot profiler capture (dir) when the health policy first escalates |
 | metrics_port           | BIGDL_METRICS_PORT          | OpenMetrics/status HTTP endpoint port (0 = ephemeral; unset = off) |
 | health_action          | BIGDL_HEALTH                | training-health policy: off / warn / skip / halt (default halt) |
 | health_halt_after      | BIGDL_HEALTH_HALT_AFTER     | halt after N consecutive nonfinite steps (default 3) |
@@ -86,6 +90,14 @@ class BigDLConfig:
     # telemetry (docs/observability.md): JSONL run logs + device facts
     telemetry_dir: Optional[str] = None
     telemetry_device: str = "auto"  # off | auto | full
+    # module-path scopes in compiled HLO (cost attribution substrate)
+    module_scopes: bool = True
+    # emit per-module attribution events (re-lower + parse per step obj)
+    telemetry_attribution: bool = False
+    # crash flight recorder: event-ring capacity (0 disables)
+    flight_events: int = 2048
+    # arm a one-shot profiler capture when health first escalates
+    profile_on_health: Optional[str] = None
     # live metrics endpoint: None = off, 0 = ephemeral port
     metrics_port: Optional[int] = None
     # training health (telemetry/health.py): off | warn | skip | halt
@@ -129,6 +141,11 @@ class BigDLConfig:
             telemetry_dir=env.get("BIGDL_TELEMETRY") or None,
             telemetry_device=(env.get("BIGDL_TELEMETRY_DEVICE")
                               or "auto").strip().lower(),
+            module_scopes=(env.get("BIGDL_SCOPES") or "on").strip().lower()
+            not in ("0", "off", "false", "no"),
+            telemetry_attribution=_truthy(env.get("BIGDL_ATTRIBUTION")),
+            flight_events=_int("BIGDL_FLIGHT", 2048),
+            profile_on_health=env.get("BIGDL_PROFILE_ON_HEALTH") or None,
             # NB: "0" is a VALID port request (ephemeral), so the usual
             # `_int(...) or None` falsiness shortcut would drop it
             metrics_port=(int(env["BIGDL_METRICS_PORT"])

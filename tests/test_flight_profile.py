@@ -1,0 +1,305 @@
+"""On-demand profiler capture + crash flight recorder
+(docs/observability.md): the ProfilerControl state machine, POST
+/profile against a LIVE training run producing a real trace directory
+without interrupting training, flight-ring bounding, and the dump paths
+(HealthError halt, straggler firing, retry exhaustion)."""
+
+import glob
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu import telemetry
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch, Transformer
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.parallel.train_step import TrainStep
+from bigdl_tpu.telemetry import profiler, schema
+from bigdl_tpu.telemetry.flight import FlightRecorder
+from bigdl_tpu.telemetry.health import HealthError
+from bigdl_tpu.utils.config import BigDLConfig, set_config
+
+
+def teardown_function(_fn):
+    telemetry.end_run()
+    set_config(None)
+    profiler.get().abort()
+
+
+def _samples(n=64, dim=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return [Sample(rng.normal(size=dim).astype(np.float32),
+                   np.int64(i % 2)) for i in range(n)]
+
+
+def _mlp(dim=6):
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(11)
+    return nn.Sequential(nn.Linear(dim, 8), nn.Tanh(), nn.Linear(8, 2),
+                         nn.LogSoftMax())
+
+
+class PoisonAt(Transformer):
+    def __init__(self, at):
+        self.at = at
+
+    def apply(self, it):
+        for i, batch in enumerate(it):
+            if i >= self.at:
+                batch = MiniBatch(
+                    [np.full_like(a, np.nan) for a in batch.inputs],
+                    list(batch.targets) or None)
+            yield batch
+
+
+# -- ProfilerControl unit ----------------------------------------------------
+def test_profiler_control_arm_poll_capture(tmp_path):
+    ctl = profiler.ProfilerControl()
+    trace_dir = str(tmp_path / "trace")
+    assert ctl.arm(2, trace_dir, source="test")
+    assert not ctl.arm(1, trace_dir), "no queueing while armed"
+    ctl.poll_begin()
+    assert ctl.status()["state"] == "capturing"
+    ctl.poll_end()
+    assert ctl.status()["state"] == "capturing"  # 1 of 2 steps done
+    ctl.poll_end()
+    st = ctl.status()
+    assert st["state"] == "idle" and st["captures"] == 1
+    assert st["last_trace_dir"] == trace_dir
+    assert os.path.isdir(trace_dir)
+    # re-armable after completion
+    assert ctl.arm(1, str(tmp_path / "trace2"))
+    ctl.abort()  # armed-but-not-started cancels cleanly
+    assert ctl.status()["state"] == "idle"
+
+
+def test_profiler_control_rejects_bad_requests(tmp_path):
+    ctl = profiler.ProfilerControl()
+    assert not ctl.arm(0, str(tmp_path))
+    assert not ctl.arm(3, "")
+
+
+def test_profiler_abort_closes_open_capture(tmp_path):
+    ctl = profiler.ProfilerControl()
+    ctl.arm(100, str(tmp_path / "t"))
+    ctl.poll_begin()
+    assert ctl.status()["state"] == "capturing"
+    ctl.abort()
+    st = ctl.status()
+    assert st["state"] == "idle" and st["captures"] == 1
+
+
+def test_bigdl_profile_env_pre_arms_the_control(tmp_path):
+    """BIGDL_PROFILE keeps working — it now pre-arms the on-demand
+    control with the first N iterations instead of a private path."""
+    trace_dir = str(tmp_path / "startup")
+    set_config(BigDLConfig(profile_dir=trace_dir, profile_iters=2,
+                           prefetch_batches=0))
+    o = optim.LocalOptimizer(_mlp(), _samples(), nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(4))
+    o.set_optim_method(optim.SGD(learning_rate=0.05))
+    o.set_health_policy(None)
+    o.optimize()
+    st = profiler.get().status()
+    assert st["captures"] >= 1 and st["state"] == "idle"
+    assert glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+
+
+# -- POST /profile against a live run (acceptance criterion) -----------------
+def test_post_profile_during_live_run_produces_trace(tmp_path):
+    tele_dir = str(tmp_path / "tele")
+    trace_dir = str(tmp_path / "ondemand")
+    set_config(BigDLConfig(telemetry_dir=tele_dir, metrics_port=0,
+                           prefetch_batches=0, health_action="off"))
+    stop = {"flag": False}
+    o = optim.LocalOptimizer(
+        _mlp(), _samples(256), nn.ClassNLLCriterion(), batch_size=8,
+        end_trigger=Trigger(
+            lambda s: stop["flag"] or s.get("neval", 0) >= 3000))
+    o.set_optim_method(optim.SGD(learning_rate=0.05))
+    result = {}
+
+    baseline = profiler.get().status()["captures"]
+
+    def drive():
+        # wait for the run's endpoint, arm a 2-step capture, then poll
+        # /status until the capture lands — training never pauses
+        deadline = time.time() + 60
+        while telemetry.metrics_server() is None:
+            if time.time() > deadline:
+                result["error"] = "metrics endpoint never came up"
+                stop["flag"] = True
+                return
+            time.sleep(0.02)
+        port = telemetry.metrics_server().port
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile?steps=2&dir={trace_dir}",
+            method="POST")
+        result["post"] = json.load(urllib.request.urlopen(req, timeout=30))
+        while time.time() < deadline:
+            st = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=30))
+            result["status"] = st
+            if st.get("profiler", {}).get("captures", 0) > baseline:
+                break
+            time.sleep(0.05)
+        stop["flag"] = True
+
+    t = threading.Thread(target=drive)
+    t.start()
+    o.optimize()
+    t.join()
+    assert "error" not in result, result["error"]
+    assert result["post"]["armed"] is True
+    prof = result["status"]["profiler"]
+    assert prof["captures"] > baseline
+    assert prof["last_trace_dir"] == trace_dir
+    assert glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True), \
+        "no trace artifacts written"
+    # /status also reports the flight recorder attached to the run
+    assert result["status"]["flight"]["capacity"] > 0
+    # training survived the capture and the log stays schema-valid
+    runs = glob.glob(os.path.join(tele_dir, "run-*.jsonl"))
+    n, errors = schema.validate_run(runs[0])
+    assert errors == [] and n > 10
+    events, _ = schema.read_events(runs[0])
+    names = [e.get("name") for e in events if e["kind"] == "event"]
+    assert "profile/armed" in names and "profile/captured" in names
+
+
+def test_post_profile_busy_returns_409(tmp_path):
+    set_config(BigDLConfig(metrics_port=0))
+    with telemetry.run(sinks=[telemetry.MemorySink()]):
+        port = telemetry.metrics_server().port
+        ctl = profiler.get()
+        assert ctl.arm(5, str(tmp_path / "t"), source="test")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile?steps=2", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 409
+        ctl.abort()
+
+
+# -- flight recorder ---------------------------------------------------------
+def test_flight_ring_is_bounded_and_dumps(tmp_path):
+    fr = FlightRecorder(capacity=8)
+    for i in range(50):
+        fr.emit({"kind": "step", "step": i})
+    fr.emit({"kind": "health", "step": 50, "grad_norm": 1.0})
+    path = fr.dump("unit_test", evidence={"why": "test"},
+                   directory=str(tmp_path))
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    assert len(doc["events"]) == 8, "ring must stay bounded"
+    assert doc["events"][-1]["kind"] == "health"
+    assert doc["reason"] == "unit_test"
+    assert doc["evidence"] == {"why": "test"}
+    assert doc["last_health"]["step"] == 50
+    assert fr.status()["dumps"] == 1
+    assert fr.status()["last_dump_path"] == path
+
+
+def test_flight_recorder_attaches_to_runs_and_bigdl_flight_0_disables():
+    set_config(BigDLConfig(flight_events=16))
+    with telemetry.run(sinks=[telemetry.MemorySink()]):
+        fr = telemetry.flight_recorder()
+        assert fr is not None and fr.capacity == 16
+        telemetry.instant("epoch", epoch=1)
+        assert fr.status()["events_buffered"] >= 1
+    assert telemetry.flight_recorder() is None, "detached at end_run"
+    set_config(BigDLConfig(flight_events=0))
+    with telemetry.run(sinks=[telemetry.MemorySink()]):
+        assert telemetry.flight_recorder() is None
+
+
+def test_health_halt_dumps_flight_with_evidence(tmp_path):
+    tele_dir = str(tmp_path / "tele")
+    set_config(BigDLConfig(telemetry_dir=tele_dir, health_action="halt",
+                           health_halt_after=2, prefetch_batches=0,
+                           failure_retry_times=3,
+                           failure_retry_interval=60.0))
+    ds = DataSet.array(_samples()).transform(
+        SampleToMiniBatch(16)).transform(PoisonAt(2))
+    o = optim.LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(20))
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    with pytest.raises(HealthError):
+        o.optimize()
+    dumps = glob.glob(os.path.join(tele_dir, "flight-*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "health_halt"
+    assert doc["evidence"]["nonfinite_grads"] > 0
+    assert doc["last_health"].get("step") is not None
+    kinds = {e.get("kind") for e in doc["events"]}
+    assert "step" in kinds and "health" in kinds
+    # the dump is announced in the run log itself
+    runs = glob.glob(os.path.join(tele_dir, "run-*.jsonl"))
+    events, _ = schema.read_events(runs[0])
+    flights = [e for e in events
+               if e["kind"] == "event" and e.get("name") == "flight/dump"]
+    assert len(flights) == 1 and flights[0]["path"] == dumps[0]
+
+
+def test_straggler_timeout_dumps_flight(tmp_path, monkeypatch):
+    tele_dir = str(tmp_path / "tele")
+    set_config(BigDLConfig(telemetry_dir=tele_dir, health_action="off",
+                           iteration_timeout="0.2", prefetch_batches=0,
+                           failure_retry_times=0,
+                           failure_retry_interval=60.0))
+    from bigdl_tpu.optim.optimizer import StragglerTimeout
+
+    # slow down the GUARDED half of the iteration (the device step, not
+    # the data wait): iteration 3 stalls past the straggler budget
+    calls = {"n": 0}
+    orig = TrainStep.run_sharded
+
+    def wedged(self, x, y, key):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            time.sleep(2.0)
+        return orig(self, x, y, key)
+
+    monkeypatch.setattr(TrainStep, "run_sharded", wedged)
+    o = optim.LocalOptimizer(_mlp(), _samples(), nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(40))
+    o.set_optim_method(optim.SGD(learning_rate=0.05))
+    with pytest.raises(StragglerTimeout):
+        o.optimize()
+    dumps = glob.glob(os.path.join(tele_dir, "flight-*.json"))
+    assert dumps, "straggler firing must leave a flight dump"
+    reasons = {json.load(open(p))["reason"] for p in dumps}
+    assert "straggler_timeout" in reasons
+
+
+def test_health_escalation_arms_one_shot_profile(tmp_path):
+    """BIGDL_PROFILE_ON_HEALTH: the first warn-level finding arms a
+    one-shot capture so the diverging step itself gets traced."""
+    prof_dir = str(tmp_path / "onhealth")
+    set_config(BigDLConfig(telemetry_dir=str(tmp_path / "tele"),
+                           health_action="warn", prefetch_batches=0,
+                           profile_on_health=prof_dir))
+    ds = DataSet.array(_samples()).transform(
+        SampleToMiniBatch(16)).transform(PoisonAt(2))
+    o = optim.LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_iteration(6))
+    o.set_optim_method(optim.SGD(learning_rate=0.1))
+    o.optimize()  # warn never halts
+    st = profiler.get().status()
+    assert st["captures"] >= 1
+    assert glob.glob(os.path.join(prof_dir, "**", "*"), recursive=True)

@@ -385,6 +385,30 @@ def test_diff_threshold_and_json(tmp_path, capsys):
     assert {"a", "b", "rows"} <= set(doc)
     assert any(r["name"] == "step_p50_s" for r in doc["rows"])
     assert rc in (0, 1)
+    # machine-readable verdict (CI consumes the payload, not the table):
+    # the verdict/exit code travel IN the JSON and agree with the rc
+    assert doc["verdict"] == ("regressed" if rc == 1 else "ok")
+    assert doc["exit_code"] == rc
+    assert doc["regressions"] == sum(r["regressed"] for r in doc["rows"])
+    assert doc["compared"] == len(doc["rows"])
+    assert doc["threshold_pct"] == 10.0 and doc["count_slack"] == 0
+
+
+def test_diff_json_verdict_covers_all_exit_codes(tmp_path, capsys):
+    from bigdl_tpu.telemetry import __main__ as cli
+
+    fast, slow = tmp_path / "fast.jsonl", tmp_path / "slow.jsonl"
+    _write_run(fast, 0.010)
+    _write_run(slow, 0.016)
+    assert cli.main(["diff", str(fast), str(slow), "--json"]) == 1
+    assert json.loads(capsys.readouterr().out)["verdict"] == "regressed"
+    assert cli.main(["diff", str(fast), str(fast), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["verdict"] == "ok"
+    bare = tmp_path / "bare.json"  # bench doc with no metrics at all
+    bare.write_text("{}")
+    assert cli.main(["diff", str(fast), str(bare), "--json"]) == 2
+    out = capsys.readouterr().out
+    assert json.loads(out)["verdict"] == "not_comparable"
 
 
 def test_diff_zero_baseline_still_regresses():

@@ -1,0 +1,96 @@
+"""Schema-drift guard: every event kind and stream name the sources
+emit must be registered in ``telemetry/schema.py`` — a new event can't
+silently bypass ``--validate`` and the readers (report, diff,
+metrics_http) that key off names.
+
+The scan is purely lexical (literal first arguments of the emit
+helpers), so adding an event stream means adding its name to
+``schema.KINDS`` / ``schema.STREAM_NAMES`` in the same change — which
+is exactly the point."""
+
+import glob
+import os
+import re
+
+from bigdl_tpu.telemetry import schema
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: literal emit kinds: tracer.emit("<kind>", ...)
+_KIND_RE = re.compile(r'\.emit\(\s*"(\w+)"')
+#: literal stream names through the typed helpers
+_NAME_RE = re.compile(
+    r'\.(?:instant|gauge|counter|stage|span|begin)\(\s*"([^"]+)"')
+#: instants spelled as emit("event", name="...")
+_EVENT_NAME_RE = re.compile(r'\.emit\(\s*"event",\s*name="([^"]+)"')
+#: compile events carry a literal dispatch-kind name
+_COMPILE_NAME_RE = re.compile(r'\.emit\(\s*"compile",\s*name="([^"]+)"')
+#: Metrics pipeline stages (forwarded into stage events by the bridge)
+_STAGE_RE = re.compile(r'(?:metrics\.add|self\.metrics\.add|\.timer)'
+                       r'\(\s*"([^"]+)"')
+#: health findings are built as ("health/<x>", attrs) tuples
+_FINDING_RE = re.compile(r'\(\s*"(health/[\w]+)"')
+
+
+def _sources():
+    paths = glob.glob(os.path.join(REPO, "bigdl_tpu", "**", "*.py"),
+                      recursive=True)
+    paths += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    paths += [os.path.join(REPO, "bench.py")]
+    # the registry itself and this test don't count as emitters
+    skip = os.path.join("telemetry", "schema.py")
+    return [p for p in paths if os.path.exists(p) and skip not in p]
+
+
+def _scan():
+    kinds, names = set(), set()
+    for path in _sources():
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        kinds.update(_KIND_RE.findall(src))
+        names.update(_NAME_RE.findall(src))
+        names.update(_EVENT_NAME_RE.findall(src))
+        names.update(_COMPILE_NAME_RE.findall(src))
+        names.update(_STAGE_RE.findall(src))
+        if path.endswith(os.path.join("telemetry", "health.py")):
+            names.update(_FINDING_RE.findall(src))
+    return kinds, names
+
+
+def test_every_emitted_kind_is_registered():
+    kinds, _ = _scan()
+    # pattern-rot tripwire: the scan must keep seeing the core kinds
+    assert {"step", "compile", "device_facts", "health",
+            "attribution"} <= kinds
+    unregistered = sorted(kinds - set(schema.KINDS))
+    assert unregistered == [], (
+        f"event kinds emitted but not in schema.KINDS: {unregistered} — "
+        f"register them (with their required fields) in "
+        f"telemetry/schema.py")
+
+
+def test_every_emitted_stream_name_is_registered():
+    _, names = _scan()
+    assert {"train/iteration", "data_wait", "straggler/timeout",
+            "prefetch/queue_depth", "profile/armed",
+            "flight/dump"} <= names, "name scan lost its anchors"
+    unregistered = sorted(names - set(schema.STREAM_NAMES))
+    assert unregistered == [], (
+        f"stream names emitted but not in schema.STREAM_NAMES: "
+        f"{unregistered} — register them in telemetry/schema.py so "
+        f"--validate and the readers know about them")
+
+
+def test_registry_names_are_not_stale():
+    """The reverse direction, advisory-strength: names in the registry
+    should still have an emitter somewhere (catches renames that forget
+    the registry).  'computing time' is emitted via a ternary the
+    lexical scan can't see; dispatch kinds are built dynamically."""
+    _, names = _scan()
+    allowed_unseen = {"computing time", "TrainStep.run",
+                      "TrainStep.run_sharded", "TrainStep.run_scan",
+                      "EvalStep.run"}
+    stale = sorted(set(schema.STREAM_NAMES) - names - allowed_unseen)
+    assert stale == [], (
+        f"STREAM_NAMES entries with no emitter found: {stale} — "
+        f"remove them or fix the rename")

@@ -43,10 +43,23 @@ port = sys.argv[1]
 st = json.load(urllib.request.urlopen(
     f"http://127.0.0.1:{port}/status", timeout=2))
 step = st.get("step") or {}
-print(f"status: step={step.get('step', '?')} loss={step.get('loss', '?')} "
-      f"throughput={step.get('throughput', '?')} "
-      f"nonfinite={st.get('nonfinite_steps', 0)} "
-      f"compiles={st.get('compiles', 0)}")
+line = (f"status: step={step.get('step', '?')} loss={step.get('loss', '?')} "
+        f"throughput={step.get('throughput', '?')} "
+        f"nonfinite={st.get('nonfinite_steps', 0)} "
+        f"compiles={st.get('compiles', 0)}")
+# on-demand profiler + flight recorder (telemetry/profiler.py,
+# telemetry/flight.py): show a capture in flight / the last artifacts so
+# a sweep babysitter knows a POST /profile actually landed
+prof = st.get("profiler") or {}
+if prof.get("state", "idle") != "idle":
+    line += (f" profiler={prof['state']}:{prof.get('steps_left', '?')}"
+             f"->{prof.get('trace_dir', '?')}")
+elif prof.get("last_trace_dir"):
+    line += f" last_trace={prof['last_trace_dir']}"
+flight = st.get("flight") or {}
+if flight.get("last_dump_path"):
+    line += f" flight_dump={flight['last_dump_path']}"
+print(line)
 PY
 }
 
