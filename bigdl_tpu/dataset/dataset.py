@@ -48,12 +48,24 @@ class AbstractDataSet:
 
 
 class LocalDataSet(AbstractDataSet):
-    """(``dataset/DataSet.scala:110``)."""
+    """(``dataset/DataSet.scala:110``).
+
+    Epoch ordering is DETERMINISTIC and seekable: epoch 0 iterates the
+    base order (``_perm``, an identity permutation until ``shuffle()``),
+    and every later epoch's permutation derives from a counter-based
+    generator keyed by ``(shuffle seed, epoch index)`` — not from
+    consuming the global RNG stream.  That makes the order a pure
+    function of (seed, epoch), which is what preemption-safe resume
+    rests on: ``set_position(epoch)`` re-enters any epoch's exact order
+    in O(1), with no replayed or skipped records
+    (docs/fault_tolerance.md)."""
 
     def __init__(self, data, transformers: Optional[List[Transformer]] = None):
         self._data = list(data) if not isinstance(data, np.ndarray) else data
         self._transformers = transformers or []
         self._perm = np.arange(len(self._data))
+        self._epoch = 0
+        self._shuffle_seed = int(RNG.get_seed()) & (2 ** 63 - 1)
 
     def size(self) -> int:
         return len(self._data)
@@ -62,19 +74,36 @@ class LocalDataSet(AbstractDataSet):
         self._perm = RNG.permutation(len(self._data))
         return self
 
+    def set_position(self, epoch: int) -> "LocalDataSet":
+        """Start the next ``data(train=True)`` iterator at the beginning
+        of 0-based ``epoch`` (checkpoint resume seeks here, then skips
+        the records already consumed within the epoch)."""
+        self._epoch = max(int(epoch), 0)
+        return self
+
+    def _perm_for_epoch(self, epoch: int) -> np.ndarray:
+        if epoch <= 0:
+            return self._perm
+        gen = np.random.Generator(np.random.Philox(
+            key=np.array([self._shuffle_seed, epoch], dtype=np.uint64)))
+        return self._perm[gen.permutation(len(self._data))]
+
     def transform(self, transformer: Transformer) -> "LocalDataSet":
         ds = LocalDataSet.__new__(LocalDataSet)
         ds._data = self._data
         ds._perm = self._perm
+        ds._epoch = self._epoch
+        ds._shuffle_seed = self._shuffle_seed
         ds._transformers = self._transformers + [transformer]
         return ds
 
     def _raw_iter(self, train: bool) -> Iterator:
         if train:
+            epoch = self._epoch
             while True:
-                for i in self._perm:
+                for i in self._perm_for_epoch(epoch):
                     yield self._data[i]
-                self.shuffle()
+                epoch += 1
         else:
             for i in range(len(self._data)):
                 yield self._data[i]
@@ -107,6 +136,8 @@ class DistributedDataSet(LocalDataSet):
         ds = DistributedDataSet.__new__(DistributedDataSet)
         ds._data = self._data
         ds._perm = self._perm
+        ds._epoch = self._epoch
+        ds._shuffle_seed = self._shuffle_seed
         ds.num_shards, ds.shard_index = self.num_shards, self.shard_index
         ds._global_size = self._global_size
         ds._transformers = self._transformers + [transformer]

@@ -1,0 +1,302 @@
+"""Deterministic, seeded fault injection (docs/fault_tolerance.md).
+
+The recovery machinery this framework ports from the reference — the
+checkpoint retry loop (``DistriOptimizer.scala:790-856``), the straggler
+watchdog, the health halt/skip policy, the flight recorder — is only
+trustworthy if something actually exercises it.  This module is that
+something: a :class:`FaultPlan` parsed from ``BIGDL_FAULTS`` (an env var
+so the plan reaches every multihost subprocess worker unchanged)
+describes *which* failure fires *where* and *when*, and thin injection
+hooks wired into the hot paths make it happen — each fault exactly once,
+each announced with a ``fault/injected`` telemetry instant so the run
+log and the flight-recorder ring carry the ground truth a test (or a
+postmortem) asserts against.
+
+Plan syntax — comma-separated ``kind[@step][:pP]`` specs::
+
+    BIGDL_FAULTS="crash@12,nan_grads@30,wedge@45,kill_worker@20:p1,torn_ckpt,data_err@7"
+
+- ``kind`` — one of :data:`KINDS` (below);
+- ``@step`` — the 1-based training iteration (for ``data_err``: the
+  1-based batch fetch; for ``torn_ckpt``: the first checkpoint written
+  at ``neval >= step``).  Omitted = the first opportunity;
+- ``:pP`` — restrict to process index ``P`` (multihost); omitted = the
+  fault fires on every process (SPMD-consistent, which is what a
+  slice-wide event like preemption looks like).
+
+| kind          | injection point                  | exercises            |
+|---------------|----------------------------------|----------------------|
+| ``crash``     | Optimizer iteration loop         | retry + restore      |
+| ``wedge``     | inside the guarded iteration     | straggler watchdog   |
+| ``kill_worker``| Optimizer loop (SIGKILL self)   | cluster restart/resume|
+| ``preempt``   | Optimizer loop (SIGTERM self)    | graceful preemption  |
+| ``nan_grads`` | TrainStep gradient path (in-graph)| health halt/skip    |
+| ``data_err``  | dataset fetch (prefetch relay)   | retry on data errors |
+| ``torn_ckpt`` | checkpoint write (post-commit)   | digest verify + quarantine |
+
+Determinism: the spec is positional (step numbers, not probabilities)
+and the only random choices (which bytes ``torn_ckpt`` flips) come from
+a Philox generator seeded by ``BIGDL_FAULTS_SEED`` — the same plan +
+seed reproduces the same failure byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+__all__ = ["KINDS", "FaultSpec", "FaultPlan", "InjectedFault",
+           "get_plan", "reset"]
+
+log = logging.getLogger("bigdl_tpu.faults")
+
+#: every fault class the plan understands (docs/fault_tolerance.md)
+KINDS = ("crash", "wedge", "kill_worker", "preempt", "nan_grads",
+         "data_err", "torn_ckpt")
+
+#: kinds polled by the Optimizer iteration loop
+_ITERATION_KINDS = ("crash", "wedge", "kill_worker", "preempt")
+
+#: how long a wedged iteration sleeps — far past any sane straggler
+#: budget; only the watchdog (or the harness timeout) ends it
+WEDGE_SLEEP_S = 3600.0
+
+_SPEC_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?"
+                      r"(?::p(?P<proc>\d+))?$")
+
+
+class InjectedFault(RuntimeError):
+    """A crash/data fault planted by the FaultPlan — indistinguishable
+    from a real failure to the retry loop (that is the point), but
+    greppable in logs and flight dumps."""
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    step: Optional[int] = None     # None = first opportunity
+    process: Optional[int] = None  # None = every process
+    fired: bool = False
+    spec: str = ""                 # original text, for logs
+
+    def matches(self, step: int, process_index: int) -> bool:
+        if self.fired:
+            return False
+        if self.process is not None and self.process != process_index:
+            return False
+        if self.step is None:
+            return True
+        if self.kind == "torn_ckpt":
+            # checkpoints land on trigger steps only; fire on the first
+            # write at-or-after the requested step
+            return step >= self.step
+        return step == self.step
+
+
+class FaultPlan:
+    """The parsed plan plus the exactly-once firing bookkeeping.
+
+    Thread-safe: the data fault fires on the prefetch thread and the
+    checkpoint fault can fire on the async-checkpoint writer thread.
+    """
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = specs
+        self.seed = int(seed)
+        self._rng = np.random.Generator(
+            np.random.Philox(key=np.uint64(self.seed & (2 ** 64 - 1))))
+        self._lock = threading.Lock()
+        self._data_fetches = 0
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        specs: List[FaultSpec] = []
+        for raw in (text or "").split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _SPEC_RE.match(raw)
+            if m is None or m.group("kind") not in KINDS:
+                raise ValueError(
+                    f"bad fault spec {raw!r} (want kind[@step][:pP] with "
+                    f"kind in {KINDS})")
+            specs.append(FaultSpec(
+                kind=m.group("kind"),
+                step=int(m.group("step")) if m.group("step") else None,
+                process=int(m.group("proc")) if m.group("proc") else None,
+                spec=raw))
+        return cls(specs, seed=seed)
+
+    def has(self, kind: str) -> bool:
+        return any(s.kind == kind for s in self.specs)
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    # -- firing --------------------------------------------------------------
+    def _process_index(self) -> int:
+        try:
+            from bigdl_tpu.utils.engine import Engine
+
+            return Engine.process_index()
+        except Exception:  # noqa: BLE001 - engine not initialized
+            return 0
+
+    def _claim(self, kinds, step: int) -> Optional[FaultSpec]:
+        """Atomically claim the first unfired matching spec."""
+        pidx = self._process_index()
+        with self._lock:
+            for s in self.specs:
+                if s.kind in kinds and s.matches(step, pidx):
+                    s.fired = True
+                    return s
+        return None
+
+    def _announce(self, spec: FaultSpec, step: int, point: str) -> None:
+        from bigdl_tpu import telemetry
+
+        log.warning(f"[Faults] injecting {spec.spec or spec.kind} "
+                    f"at step {step} ({point})")
+        telemetry.instant("fault/injected", fault=spec.kind, step=step,
+                          point=point, spec=spec.spec)
+
+    def poll_iteration(self, step: int) -> Optional[str]:
+        """Called by the Optimizer at the top of iteration ``step``.
+        ``crash`` raises, ``kill_worker``/``preempt`` signal this
+        process; ``wedge`` is returned to the caller, which must stall
+        INSIDE the straggler-guarded region (the watchdog is the
+        mechanism under test)."""
+        spec = self._claim(_ITERATION_KINDS, step)
+        if spec is None:
+            return None
+        self._announce(spec, step, "iteration")
+        if spec.kind == "crash":
+            raise InjectedFault(f"injected crash at step {step}")
+        if spec.kind == "kill_worker":
+            # the ungraceful death: no handler runs, no checkpoint
+            # commits — recovery is the NEXT process's resume path
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(60)  # SIGKILL delivery is asynchronous
+        if spec.kind == "preempt":
+            # deliver a REAL signal so the grace-window handler path is
+            # exercised, not simulated
+            os.kill(os.getpid(), signal.SIGTERM)
+            return None
+        return "wedge"
+
+    def wedge_stall(self) -> None:
+        """The stall body for a claimed ``wedge`` — runs inside the
+        straggler-guarded iteration thread."""
+        time.sleep(WEDGE_SLEEP_S)
+
+    def grad_scale(self, step: int) -> float:
+        """Multiplier folded into the gradients of iteration ``step`` by
+        the compiled train step: 1.0 normally, NaN when a ``nan_grads``
+        fault fires — the poison enters through the GRAD path, so the
+        in-graph health probe sees nonfinite grads exactly as a real
+        divergence would produce them."""
+        spec = self._claim(("nan_grads",), step)
+        if spec is None:
+            return 1.0
+        self._announce(spec, step, "grads")
+        return float("nan")
+
+    def wrap_data_iter(self, it: Iterator) -> Iterator:
+        """Wrap the dataset batch iterator: the Nth fetch (1-based,
+        process-wide across run attempts) raises :class:`InjectedFault`
+        on whatever thread performs it — under prefetch, the producer
+        thread, exercising the error relay into the retry loop."""
+        if not self.has("data_err"):
+            return it
+
+        def gen():
+            for batch in it:
+                with self._lock:
+                    self._data_fetches += 1
+                    n = self._data_fetches
+                spec = self._claim(("data_err",), n)
+                if spec is not None:
+                    self._announce(spec, n, "data")
+                    raise InjectedFault(f"injected data error at fetch {n}")
+                yield batch
+
+        return gen()
+
+    def poll_checkpoint(self, path: str, step: int) -> None:
+        """Called after a checkpoint write COMMITS (meta marker on
+        disk): a ``torn_ckpt`` fault then corrupts one payload file
+        under ``path`` while the complete-marker stays valid — the exact
+        tear the marker cannot catch and the content digests must."""
+        spec = self._claim(("torn_ckpt",), step)
+        if spec is None:
+            return
+        torn = self._corrupt_one_file(path)
+        self._announce(spec, step, f"checkpoint:{torn or 'none'}")
+
+    def _corrupt_one_file(self, path: str) -> Optional[str]:
+        """Flip bytes in the middle of the largest payload file under
+        ``path`` (meta markers excluded — the tear must be silent).
+        Returns the corrupted file's path."""
+        candidates = []
+        if os.path.isfile(path):
+            candidates = [path]
+        else:
+            for root, _dirs, files in os.walk(path):
+                for f in files:
+                    if f.endswith(".json"):  # meta/commit markers stay valid
+                        continue
+                    p = os.path.join(root, f)
+                    candidates.append(p)
+        candidates = [p for p in candidates if os.path.getsize(p) > 0]
+        if not candidates:
+            return None
+        # largest file = a real shard payload, deterministically chosen
+        target = max(candidates, key=lambda p: (os.path.getsize(p), p))
+        size = os.path.getsize(target)
+        span = max(1, min(64, size // 2))
+        offset = int(self._rng.integers(0, max(1, size - span)))
+        junk = self._rng.integers(0, 256, size=span, dtype=np.uint8)
+        with open(target, "r+b") as fh:
+            fh.seek(offset)
+            original = fh.read(span)
+            flipped = bytes(b ^ 0xA5 for b in original) or bytes(junk)
+            fh.seek(offset)
+            fh.write(flipped)
+        log.warning(f"[Faults] tore {target} ({span} bytes at {offset})")
+        return target
+
+
+# -- process-wide plan -------------------------------------------------------
+_plan: Optional[FaultPlan] = None
+_plan_lock = threading.Lock()
+
+
+def get_plan() -> FaultPlan:
+    """The process-wide plan, parsed once from ``BIGDL_FAULTS`` /
+    ``BIGDL_FAULTS_SEED`` (empty plan when unset).  Cached so the
+    exactly-once bookkeeping survives config re-resolution; tests use
+    :func:`reset` between scenarios."""
+    global _plan
+    with _plan_lock:
+        if _plan is None:
+            from bigdl_tpu.utils.config import get_config
+
+            cfg = get_config()
+            _plan = FaultPlan.parse(cfg.faults, seed=cfg.faults_seed)
+        return _plan
+
+
+def reset() -> None:
+    """Drop the cached plan (tests; a fresh plan re-reads the env)."""
+    global _plan
+    with _plan_lock:
+        _plan = None

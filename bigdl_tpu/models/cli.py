@@ -198,6 +198,15 @@ def cmd_train(args) -> None:
         o.set_validation_summary(
             ValidationSummary(args.summary_dir, args.app_name))
     trained = o.optimize()
+    if getattr(o, "preempted", False):
+        # graceful SIGTERM/SIGINT: the final checkpoint is committed;
+        # exit 0 — rerunning this exact command resumes mid-epoch
+        # (docs/fault_tolerance.md)
+        print(f"preempted at iteration {o.state['neval']} "
+              f"(epoch {o.state['epoch']}); checkpoint committed"
+              + (f" under {args.checkpoint}" if args.checkpoint else "")
+              + " — rerun to resume")
+        return
     res = optim.Evaluator(trained, batch_size=args.batch_size).evaluate(
         val_samples, val_methods)
     for r, m in res:

@@ -16,16 +16,22 @@ reference's two classes drive the same semantics.
 
 from __future__ import annotations
 
+import atexit
 import logging
 import os
+import random
 import re
+import signal
+import threading
 import time
+import weakref
 from datetime import datetime
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from bigdl_tpu import faults as _faults
 from bigdl_tpu import telemetry
 from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet
 from bigdl_tpu.dataset.minibatch import MiniBatch
@@ -51,6 +57,87 @@ class StragglerTimeout(RuntimeError):
     (see docs/straggler.md).  Raised into the retry loop, which restores
     the latest checkpoint — the SPMD analogue of the reference's
     drop-gradients-and-continue (``DistriOptimizer.scala:415-420``)."""
+
+
+#: BIGDL_RESUME spellings — every other boolean knob accepts 0/false/no,
+#: so auto-resume must too (a knob meant to DISABLE resuming that
+#: silently resumed would be the worst possible failure mode)
+_RESUME_ON = frozenset({"auto", "on", "1", "true", "yes"})
+_RESUME_OFF = frozenset({"off", "0", "false", "no"})
+
+#: optimizers with an async checkpoint write possibly in flight — a
+#: clean interpreter exit right after the last step must JOIN them, or
+#: the tail of the write (meta commit included) is silently abandoned
+#: and the newest checkpoint never becomes discoverable
+_LIVE_CKPT_WRITERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_ckpt_writes_at_exit():
+    for o in list(_LIVE_CKPT_WRITERS):
+        try:
+            o._join_checkpoint_write()
+        except Exception:  # noqa: BLE001 - exit path must not raise
+            pass
+
+
+class _PreemptGuard:
+    """Grace-window SIGTERM/SIGINT handling (docs/fault_tolerance.md).
+
+    The first signal only sets a flag: the training loop finishes the
+    in-flight step, commits a final checkpoint carrying the dataset /
+    epoch position and host-RNG state, emits ``run/preempted``, and
+    returns normally (the process exits 0) — the shape of a TPU-slice
+    preemption notice honored.  A second signal means "now": the
+    original disposition is restored and re-raised, so a stuck grace
+    window can still be killed.
+
+    Installable only on the main thread (CPython restricts
+    ``signal.signal``); elsewhere it degrades to a no-op and SIGTERM
+    keeps its default (kill) semantics.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.requested = threading.Event()
+        self.signum: Optional[int] = None
+        self._old = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        if self.requested.is_set():
+            # second signal: restore + re-deliver — immediate semantics
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        self.signum = signum
+        self.requested.set()
+        log.warning(f"[Preempt] received signal {signum}: finishing the "
+                    f"in-flight step, then committing a final checkpoint "
+                    f"(send again to stop immediately)")
+
+    def install(self) -> "_PreemptGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        try:
+            for sig in self.SIGNALS:
+                self._old[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        except (ValueError, OSError):  # non-main interpreter contexts
+            self._old.clear()
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old.clear()
+        self._installed = False
 
 log = logging.getLogger("bigdl_tpu.optim")
 if not log.handlers:
@@ -343,6 +430,16 @@ class Optimizer:
                 fut.result()
             self._ckpt_future = None
 
+    def _driver_state_snapshot(self) -> Dict:
+        """The driver state a checkpoint carries: epoch/iteration/record
+        position PLUS the host-RNG state and the run's step-key seed —
+        everything a fresh process needs to resume mid-epoch on the
+        exact batch and random stream the interrupted run would have
+        used next (docs/fault_tolerance.md)."""
+        snap = dict(self.state)
+        snap["rng_state"] = RNG.get_state()
+        return snap
+
     def _save_checkpoint(self, step: TrainStep):
         if self._checkpoint_dir() is None:
             return
@@ -359,7 +456,8 @@ class Optimizer:
             dest = File.join(self._ckpt_dir, f"sharded.{n}")
             use_async = get_config().async_checkpoint
             finish = sharded_ckpt.save_train_step(
-                step, dest, extra={"driver_state": dict(self.state)},
+                step, dest,
+                extra={"driver_state": self._driver_state_snapshot()},
                 wait=not use_async)
 
             def tail():
@@ -367,7 +465,8 @@ class Optimizer:
                     finish()
                 if self._ckpt_keep and Engine.is_coordinator():
                     for p in sharded_ckpt.prune_old(self._ckpt_dir,
-                                                    self._ckpt_keep):
+                                                    self._ckpt_keep,
+                                                    trusted=dest):
                         log.info(f"[Checkpoint] pruned {p}")
                 log.info(f"[Checkpoint] saved sharded.{n} "
                          f"to {self._ckpt_dir}")
@@ -386,7 +485,7 @@ class Optimizer:
         # single-writer-safe checkpointing
         step.sync_to_model()
         n = self.state["neval"]
-        self.optim_method.state["driver_state"] = dict(self.state)
+        self.optim_method.state["driver_state"] = self._driver_state_snapshot()
         self.optim_method.state["func_state"] = jax.tree.map(
             np.asarray, step.gather_replicated(step.opt_state))
         if not Engine.is_coordinator():
@@ -394,16 +493,33 @@ class Optimizer:
         # snapshot to bytes NOW (consistent state); the IO can overlap
         # with the next training iterations (BIGDL_ASYNC_CHECKPOINT)
         self._join_checkpoint_write()
+        from bigdl_tpu.utils import ckpt_digest
+
         blobs = [(dumps(self.model, kind="module"),
                   os.path.join(self._ckpt_dir, f"model.{n}")),
                  (dumps(self.optim_method, kind="optim"),
                   os.path.join(self._ckpt_dir, f"optimMethod.{n}"))]
+        # content digests of the exact bytes being written, committed in
+        # a meta marker AFTER the payload lands — restore verifies them
+        # before loading, so a torn/bit-rotted pair is quarantined, not
+        # silently deserialized
+        meta = {"neval": n,
+                "digests": {os.path.basename(p): ckpt_digest.digest_bytes(b)
+                            for b, p in blobs}}
+        meta_path = os.path.join(self._ckpt_dir, f"ckptmeta.{n}.json")
 
         def write():
+            import json as _json
+
             for blob, path in blobs:
                 File.save(blob, path, overwrite=True)
+            File.save(_json.dumps(meta).encode(), meta_path, overwrite=True)
+            try:  # fault injection: tear the committed model payload
+                _faults.get_plan().poll_checkpoint(blobs[0][1], n)
+            except Exception:  # noqa: BLE001 - injection never fails a save
+                pass
             if self._ckpt_keep:
-                self._prune_btpu()
+                self._prune_btpu(trusted=n)
             log.info(f"[Checkpoint] saved model.{n} / optimMethod.{n} "
                      f"to {self._ckpt_dir}")
             telemetry.instant("checkpoint/saved", step=n, backend="btpu")
@@ -419,17 +535,40 @@ class Optimizer:
         if getattr(self, "_ckpt_pool", None) is None:
             self._ckpt_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="bigdl-ckpt")
+        # interpreter exit joins this write (atexit drain): a fast exit
+        # right after the last step must not abandon the meta commit
+        _LIVE_CKPT_WRITERS.add(self)
         return self._ckpt_pool.submit(fn)
 
-    def _prune_btpu(self):
-        """Keep only the newest ``keep`` model/optimMethod file pairs —
-        coordinator-only (the btpu write path already is)."""
+    def _prune_btpu(self, trusted: Optional[int] = None):
+        """Keep only the newest ``keep`` model/optimMethod pairs (meta
+        markers pruned with them) — coordinator-only (the btpu write
+        path already is).  The newest VERIFIED pair is never deleted:
+        if every newer checkpoint turns out torn, it is the only state
+        a restore can still fall back to.  ``trusted`` marks the step
+        number this very write just produced and digested, sparing a
+        re-read+hash per save."""
+        d = self._ckpt_dir
         nums = sorted(int(m.group(1))
-                      for f in File.listdir(self._ckpt_dir)
+                      for f in File.listdir(d)
                       if (m := re.match(r"model\.(\d+)$", f)))
-        for n in nums[:-self._ckpt_keep]:
-            for prefix in ("model", "optimMethod"):
-                File.remove(File.join(self._ckpt_dir, f"{prefix}.{n}"))
+        victims = nums[:-self._ckpt_keep]
+        if victims and not any(n == trusted or self._btpu_verify(d, n)[0]
+                               for n in
+                               reversed(nums[-self._ckpt_keep:])):
+            for n in reversed(victims):
+                if self._btpu_verify(d, n)[0]:
+                    victims = [v for v in victims if v != n]
+                    log.warning(f"[Checkpoint] retaining checkpoint {n} "
+                                f"beyond keep={self._ckpt_keep}: it is "
+                                f"the last verified-good one")
+                    break
+        for n in victims:
+            for name in (f"model.{n}", f"optimMethod.{n}",
+                         f"ckptmeta.{n}.json"):
+                p = File.join(d, name)
+                if File.exists(p):
+                    File.remove(p)
             log.info(f"[Checkpoint] pruned model.{n} / optimMethod.{n}")
 
     @staticmethod
@@ -450,10 +589,19 @@ class Optimizer:
         if d is None:
             return False
         self._join_checkpoint_write()
-        if self._ckpt_backend == "sharded":
-            from bigdl_tpu.utils.sharded_ckpt import latest_step_dir
+        return self._restore_from(d)
 
-            latest = latest_step_dir(d)
+    def _restore_from(self, d: str) -> bool:
+        """Restore the newest VERIFIED checkpoint under ``d``: content
+        digests are checked before anything is loaded, torn candidates
+        are quarantined (``*.corrupt`` + ``checkpoint/quarantined``)
+        and the walk falls back to the previous good step — a restore
+        either loads a byte-verified checkpoint fully or reports there
+        is none (``docs/fault_tolerance.md``)."""
+        if self._ckpt_backend == "sharded":
+            from bigdl_tpu.utils.sharded_ckpt import latest_verified_step_dir
+
+            latest = latest_verified_step_dir(d)
             if latest is None:
                 return False
             # applied onto the fresh TrainStep inside _optimize_once (the
@@ -461,17 +609,159 @@ class Optimizer:
             self._pending_sharded_restore = latest
             log.info(f"[Recovery] will restore sharded state from {latest}")
             return True
-        mfile = self.get_latest_file(d, "model")
-        ofile = self.get_latest_file(d, "optimMethod")
-        if mfile is None or ofile is None:
-            return False
         from bigdl_tpu.utils.serializer import load_module, load_optim_method
 
-        self.model = load_module(mfile)
-        self.optim_method = load_optim_method(ofile)
-        self.state.update(self.optim_method.state.get("driver_state", {}))
-        log.info(f"[Recovery] restored {mfile} and {ofile}")
-        return True
+        nums = sorted({int(m.group(1)) for f in File.listdir(d)
+                       if (m := re.match(r"model\.(\d+)$", f))},
+                      reverse=True)
+        for n in nums:
+            ok, problems = self._btpu_verify(d, n)
+            mfile = File.join(d, f"model.{n}")
+            ofile = File.join(d, f"optimMethod.{n}")
+            if ok:
+                try:
+                    model = load_module(mfile)
+                    optim_method = load_optim_method(ofile)
+                except Exception as e:  # noqa: BLE001 - treat as torn
+                    ok, problems = False, [f"load failed: {e}"]
+            if not ok:
+                self._quarantine_btpu(d, n, problems)
+                continue
+            self.model = model
+            self.optim_method = optim_method
+            self._apply_driver_state(
+                self.optim_method.state.get("driver_state", {}))
+            log.info(f"[Recovery] restored {mfile} and {ofile}")
+            return True
+        return False
+
+    def _btpu_verify(self, d: str, n: int) -> Tuple[bool, List[str]]:
+        """Digest check of the ``model.n``/``optimMethod.n`` pair against
+        its ``ckptmeta.n.json`` marker.  Pairs from before the digest
+        era (no marker) pass when both files exist — rejecting them
+        would strand every old checkpoint."""
+        import json as _json
+
+        from bigdl_tpu.utils import ckpt_digest
+
+        try:
+            meta = _json.loads(File.load(
+                File.join(d, f"ckptmeta.{n}.json")).decode())
+        except (OSError, ValueError):
+            both = all(File.exists(File.join(d, f"{p}.{n}"))
+                       for p in ("model", "optimMethod"))
+            return both, ([] if both else
+                          [f"incomplete pair at {n} (no meta marker)"])
+        problems = ckpt_digest.verify_digests(d, meta.get("digests") or {})
+        return not problems, problems
+
+    def _quarantine_btpu(self, d: str, n: int, problems: List[str]):
+        """Move a torn BTPU pair aside as ``*.corrupt`` (postmortem
+        evidence; discovery can never pick it again)."""
+        moved = []
+        for name in (f"model.{n}", f"optimMethod.{n}", f"ckptmeta.{n}.json"):
+            p = File.join(d, name)
+            if File.exists(p):
+                dest = p + ".corrupt"
+                k = 1
+                while File.exists(dest):  # never overwrite prior evidence
+                    dest = p + f".corrupt.{k}"
+                    k += 1
+                try:
+                    File.rename(p, dest)
+                    moved.append(name)
+                except OSError:
+                    log.error(f"[Checkpoint] could not quarantine {p}")
+        log.error(f"[Checkpoint] quarantined checkpoint {n} ({moved}): "
+                  f"{'; '.join(problems) or 'integrity check failed'}")
+        telemetry.instant("checkpoint/quarantined", step=n, backend="btpu",
+                          problems=list(problems))
+
+    def _apply_driver_state(self, driver_state: Dict):
+        """Fold a checkpoint's driver state into the live run: position
+        counters into ``self.state``, host-RNG state back into ``RNG``
+        (so transform randomness and key draws continue the interrupted
+        stream instead of forking)."""
+        ds = dict(driver_state or {})
+        rng_state = ds.pop("rng_state", None)
+        self.state.update(ds)
+        if rng_state:
+            try:
+                RNG.set_state(rng_state)
+            except Exception as e:  # noqa: BLE001 - resume still works,
+                # only host-random reproducibility degrades
+                log.warning(f"[Recovery] could not restore RNG state "
+                            f"({type(e).__name__}: {e})")
+
+    def _resume_sources(self) -> List[str]:
+        """Candidate directories a fresh ``optimize()`` may auto-resume
+        from, best first: the checkpoint dir itself under
+        ``overwrite_checkpoint`` (stable path), else every PREVIOUS
+        stamped subdir holding checkpoint-like files, newest first —
+        ALL of them, so a newest run whose only checkpoint turned out
+        torn falls back to the run before it."""
+        if self._ckpt_overwrite:
+            return [self._ckpt_dir]
+        stamps = sorted((s for s in File.listdir(self._ckpt_path)
+                         if re.fullmatch(r"\d{8}_\d{6}", s)), reverse=True)
+        me = os.path.basename(self._ckpt_dir)
+        out = []
+        for s in stamps:
+            if s == me:
+                continue
+            d = File.join(self._ckpt_path, s)
+            if any(f.startswith(("model.", "sharded."))
+                   for f in File.listdir(d)):
+                out.append(d)
+        return out
+
+    def _maybe_resume(self):
+        """Preemption-safe resume: when a checkpoint path is configured
+        and holds a verified checkpoint, a FRESH run continues from it —
+        mid-epoch, on the exact next batch — instead of starting over.
+        ``BIGDL_RESUME=off`` restores start-from-scratch semantics; an
+        explicitly ``set_state``-positioned run is left alone."""
+        if self._ckpt_path is None or get_config().resume in _RESUME_OFF:
+            return
+        if self.state.get("neval", 0) > 0:
+            return
+        for src in self._resume_sources():
+            if not self._restore_from(src):
+                log.warning(f"[Resume] no loadable checkpoint under "
+                            f"{src}; trying the run before it")
+                continue
+            self.state["_resumed_from"] = src
+            telemetry.instant("run/resumed", source=src,
+                              step=self.state.get("neval", 0))
+            log.info(f"[Resume] continuing from {src} at iteration "
+                     f"{self.state.get('neval', 0)} "
+                     f"(epoch {self.state.get('epoch', 1)}, "
+                     f"{self.state.get('records', 0)} records into it)")
+            return
+
+    def _fast_forward(self, data_iter, records: int, record_scale: int):
+        """Skip the batches a restored position says were already
+        consumed this epoch — the second half of mid-epoch resume (the
+        first half is the dataset's deterministic epoch order).  Host
+        transform work only; no device dispatch."""
+        t0 = time.perf_counter()
+        skipped = 0
+        while skipped < records:
+            batch = next(data_iter, None)
+            if batch is None:
+                log.warning(f"[Resume] dataset exhausted after skipping "
+                            f"{skipped}/{records} records")
+                break
+            skipped += batch.size() * record_scale
+        if skipped != records:
+            log.warning(f"[Resume] fast-forward skipped {skipped} records "
+                        f"but the checkpoint recorded {records} — batch "
+                        f"size changed between runs?")
+        else:
+            log.info(f"[Resume] fast-forwarded {skipped} records in "
+                     f"{time.perf_counter() - t0:.2f}s to resume "
+                     f"mid-epoch")
+        return data_iter
 
     # -- validation --------------------------------------------------------
     def _validate(self, eval_step: EvalStep):
@@ -579,13 +869,25 @@ class Optimizer:
         retry_times = cfg.failure_retry_times
         retry_window = cfg.failure_retry_interval
         failures: List[float] = []
-        # a bad BIGDL_HEALTH / halt_after is a CONFIG error — surface it
-        # here, before the retry loop, or it would be retried to budget
-        # exhaustion as if it were a transient training failure
+        # a bad BIGDL_HEALTH / halt_after / BIGDL_FAULTS / BIGDL_RESUME
+        # is a CONFIG error — surface it here, before the retry loop, or
+        # it would be retried to budget exhaustion as if it were a
+        # transient training failure
         self._resolve_health_policy()
+        _faults.get_plan()
+        if cfg.resume not in _RESUME_ON | _RESUME_OFF:
+            raise ValueError(
+                f"BIGDL_RESUME={cfg.resume!r}: want auto/on or off "
+                f"(falsy spellings 0/false/no also read as off)")
         self._init_checkpoint_dir()
         self._telemetry_begin(cfg)
+        self.preempted = False
+        # graceful SIGTERM/SIGINT: finish the step, commit a final
+        # checkpoint, return — the TPU-slice preemption contract
+        self._preempt = _PreemptGuard().install()
+        _LIVE_CKPT_WRITERS.add(self)
         try:
+            self._maybe_resume()
             while True:
                 try:
                     return self._optimize_once()
@@ -604,10 +906,12 @@ class Optimizer:
                 except Exception as e:  # noqa: BLE001 — retry loop parity
                     now = time.time()
                     failures = [t for t in failures if now - t < retry_window] + [now]
+                    backoff = self._retry_backoff(len(failures))
                     telemetry.instant("run/retry", error=type(e).__name__,
                                       message=str(e)[:200],
                                       attempt=len(failures),
-                                      budget=retry_times)
+                                      budget=retry_times,
+                                      backoff_s=round(backoff, 3))
                     if isinstance(e, StragglerTimeout):
                         # each firing gets its own dump: the ring holds
                         # the steps LEADING INTO the stall, which a
@@ -619,11 +923,32 @@ class Optimizer:
                             f"retry_exhausted:{type(e).__name__}")
                         raise
                     log.warning(f"training failed with {type(e).__name__}: {e}; "
-                                f"retry {len(failures)}/{retry_times}")
+                                f"retry {len(failures)}/{retry_times} "
+                                f"after {backoff:.2f}s backoff")
+                    if backoff > 0:
+                        time.sleep(backoff)
                     if not self._restore_latest():
                         log.warning("no checkpoint to restore; restarting from current weights")
         finally:
+            self._preempt.uninstall()
+            try:  # an in-flight async write must not be abandoned by an
+                # exception unwinding past the happy path's join
+                self._join_checkpoint_write()
+            except Exception:  # noqa: BLE001 - never mask the real error
+                pass
             self._telemetry_end()
+
+    def _retry_backoff(self, attempt: int) -> float:
+        """Exponential backoff with jitter between restore attempts
+        (``BIGDL_RETRY_BACKOFF`` base seconds, cap 30s): a persistently
+        failing step must not hot-loop through the retry budget in
+        milliseconds.  Jitter desynchronizes a fleet of workers retrying
+        the same shared-storage restore."""
+        base = get_config().retry_backoff
+        if base <= 0:
+            return 0.0
+        return min(30.0, base * (2.0 ** max(attempt - 1, 0))) \
+            * random.uniform(0.5, 1.0)
 
     def _flight_dump(self, reason: str, evidence: Optional[Dict] = None):
         """Dump the flight recorder (telemetry/flight.py) on the way out
@@ -652,6 +977,7 @@ class Optimizer:
     def _optimize_once(self):
         mesh = self._mesh
         health = self._resolve_health_policy()
+        fault_plan = _faults.get_plan()
         step = TrainStep(
             self.model, self.criterion, self.optim_method, mesh=mesh,
             parameter_sync=self.parameter_sync,
@@ -659,7 +985,8 @@ class Optimizer:
             compute_dtype=self.compute_dtype,
             gradient_clipping=self._grad_clip, max_norm=self._grad_clip_norm,
             health_probe=health is not None,
-            skip_nonfinite=health is not None and health.skip_nonfinite)
+            skip_nonfinite=health is not None and health.skip_nonfinite,
+            grad_fault=fault_plan.has("nan_grads"))
         # resume functional optimizer state if the method carries it
         if "func_state" in self.optim_method.state:
             restored = jax.tree.map(np.asarray, self.optim_method.state["func_state"])
@@ -671,7 +998,7 @@ class Optimizer:
 
             extra = restore_train_step(step, self._pending_sharded_restore)
             self._pending_sharded_restore = None
-            self.state.update(extra.get("driver_state", {}))
+            self._apply_driver_state(extra.get("driver_state", {}))
             step.sync_to_model()
         from bigdl_tpu.dataset.dataset import DistributedDataSet
         from bigdl_tpu.parallel.mesh import mesh_process_count
@@ -690,11 +1017,26 @@ class Optimizer:
             dataset_size = self.dataset.size()
             record_scale = 1
         records_this_epoch = self.state.get("records", 0)
+        # dataset position: every attempt (fresh resume OR retry-restore)
+        # re-enters the CURRENT epoch's deterministic order and skips the
+        # records already consumed — no replayed, no skipped batches
+        # (before this, a restore replayed the epoch from its start)
+        if hasattr(self.dataset, "set_position"):
+            self.dataset.set_position(self.state.get("epoch", 1) - 1)
         data_iter = self.dataset.data(train=True)
-        # the driver's seed draw happens BEFORE the prefetch thread starts
+        data_iter = fault_plan.wrap_data_iter(data_iter)
+        if records_this_epoch > 0:
+            data_iter = self._fast_forward(data_iter, records_this_epoch,
+                                           record_scale)
+        # the step-key seed persists in the driver state: every resume /
+        # retry attempt folds the SAME base key by iteration number, so
+        # stochastic layers replay the interrupted trajectory instead of
+        # forking it.  The draw happens BEFORE the prefetch thread starts
         # pulling batches through (possibly random) transforms, so the
         # shared host RNG sees the same draw order as the synchronous path
-        key0 = jax.random.key(RNG.randint(0, 2**31 - 1))
+        if "key0_seed" not in self.state:
+            self.state["key0_seed"] = int(RNG.randint(0, 2**31 - 1))
+        key0 = jax.random.key(self.state["key0_seed"])
         # async input: transform + h2d run ahead of the device step on a
         # host thread (BIGDL_PREFETCH=0 restores the synchronous path)
         prefetch_depth = get_config().prefetch_batches
@@ -728,6 +1070,10 @@ class Optimizer:
         tele_base = tele.depth() if tele else 0
         try:
             while not self.end_when(self.state):
+                # fault plan, iteration point: crash raises into the
+                # retry loop, kill_worker/preempt signal this process,
+                # wedge stalls INSIDE the straggler-guarded region below
+                wedge = fault_plan.poll_iteration(self.state["neval"] + 1)
                 profile_ctl.poll_begin()
                 t_start = time.perf_counter()
                 it_sid = tele.begin("train/iteration",
@@ -752,13 +1098,22 @@ class Optimizer:
 
                 def one_iteration():
                     th0 = time.perf_counter()
+                    if wedge is not None:  # injected stall: the
+                        # watchdog, not the iteration, must end this
+                        fault_plan.wedge_stall()
                     if placed is not None:
                         xs, ys = placed  # h2d already done by the prefetcher
                     else:
                         xs, ys = step._shard_batch(batch.get_input(),
                                                    batch.get_target())
                     t0 = time.perf_counter()
-                    out = step.run_sharded(xs, ys, key)
+                    if step.grad_fault:
+                        out = step.run_sharded(
+                            xs, ys, key, grad_scale=fault_plan.grad_scale(
+                                self.state["neval"] + 1))
+                    else:  # kwarg omitted: keeps stubbed/run-compatible
+                        # run_sharded signatures working unchanged
+                        out = step.run_sharded(xs, ys, key)
                     t1 = time.perf_counter()
                     out = float(out)  # device sync: the step actually runs
                     t2 = time.perf_counter()
@@ -767,8 +1122,11 @@ class Optimizer:
                     return out, (t0 - th0, t1 - t0, t2 - t0)
 
                 # the first iteration includes XLA compilation — never
-                # under the straggler budget (docs/straggler.md)
-                if first_iteration:
+                # under the straggler budget (docs/straggler.md).  An
+                # injected wedge is the one exception: unguarded it
+                # would stall the driver for the full stall instead of
+                # exercising the watchdog it exists to test.
+                if first_iteration and wedge is None:
                     loss, stage_times = one_iteration()
                 else:
                     loss, stage_times = \
@@ -847,10 +1205,38 @@ class Optimizer:
                             telemetry.span("validation"):
                         step.sync_to_model()
                         self._validate(eval_step)
-                if self._ckpt_trigger is not None and self._ckpt_trigger(self.state):
+                ckpt_fired = self._ckpt_trigger is not None \
+                    and self._ckpt_trigger(self.state)
+                if ckpt_fired:
                     with self.metrics.timer("checkpoint time"), \
                             telemetry.span("checkpoint"):
                         self._save_checkpoint(step)
+                preempt = getattr(self, "_preempt", None)
+                if preempt is not None and preempt.requested.is_set():
+                    # graceful preemption: the in-flight step finished
+                    # above; commit a final checkpoint carrying the
+                    # dataset/epoch position + RNG state (unless the
+                    # trigger just saved this very step), mark the run,
+                    # and return 0-exit clean — a fresh process resumes
+                    # from here mid-epoch
+                    if self._ckpt_path is not None and not ckpt_fired:
+                        with self.metrics.timer("checkpoint time"), \
+                                telemetry.span("checkpoint"):
+                            self._save_checkpoint(step)
+                    self._join_checkpoint_write()
+                    self.preempted = True
+                    telemetry.instant("run/preempted",
+                                      step=self.state["neval"],
+                                      epoch=self.state["epoch"],
+                                      signum=preempt.signum or 0)
+                    log.warning(
+                        f"[Preempt] run preempted at iteration "
+                        f"{self.state['neval']} (epoch "
+                        f"{self.state['epoch']}); final checkpoint "
+                        f"committed — a fresh optimize() resumes here")
+                    if tele:
+                        tele.end(it_sid)
+                    break
                 if tele:
                     tele.end(it_sid)
         except BaseException:

@@ -136,7 +136,8 @@ class TrainStep:
                  max_norm: Optional[float] = None,
                  remat: bool = False,
                  health_probe: bool = False,
-                 skip_nonfinite: bool = False):
+                 skip_nonfinite: bool = False,
+                 grad_fault: bool = False):
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -156,6 +157,12 @@ class TrainStep:
         self.remat = remat
         self.health_probe = health_probe
         self.skip_nonfinite = skip_nonfinite
+        # fault injection (bigdl_tpu/faults.py): the compiled step takes
+        # one extra traced scalar multiplied into the RAW gradients —
+        # 1.0 in healthy steps, NaN when a nan_grads fault fires, so the
+        # poison enters through the same path a real divergence would
+        # and the in-graph health probe judges it
+        self.grad_fault = grad_fault
         self.last_health = None  # device [5] vector, see PROBE_FIELDS
 
         # module-path scopes (docs/observability.md): stamped before the
@@ -257,11 +264,14 @@ class TrainStep:
 
     # -- the pure step -----------------------------------------------------
     def _step_fn(self, with_health: bool = False):
-        """The pure (params, opt_state, buffers, x, y, key) -> (params,
-        opt_state, buffers, loss[, health]) function, shared by the
-        per-iteration jit and the scan-of-iterations jit.
+        """The pure (params, opt_state, buffers, x, y, key[, grad_scale])
+        -> (params, opt_state, buffers, loss[, health]) function, shared
+        by the per-iteration jit and the scan-of-iterations jit.
         ``with_health`` appends the fused health 5-vector output (the
-        per-iteration path only — the scan path keeps the 4-tuple)."""
+        per-iteration path only — the scan path keeps the 4-tuple).
+        The optional trailing ``grad_scale`` scalar is the fault-plan
+        input (``grad_fault=True`` dispatches pass it; omitted, the
+        multiply never enters the trace)."""
         model, criterion, optim = self.model, self.criterion, self.optim
         meta = self._meta
         comp = self.gradient_compression
@@ -290,7 +300,7 @@ class TrainStep:
             # (finer-grained boundaries: wrap blocks in nn.Remat instead)
             loss_fn = jax.checkpoint(loss_fn, static_argnums=())
 
-        def step(params, opt_state, buffers, x, y, key):
+        def step(params, opt_state, buffers, x, y, key, grad_scale=None):
             if mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
@@ -300,6 +310,11 @@ class TrainStep:
                         a, jax.sharding.NamedSharding(mesh, P(ax, *([None] * (a.ndim - 1))))), x)
             grads, (loss, new_buffers, _) = jax.grad(loss_fn, has_aux=True)(
                 params, buffers, x, y, key)
+            if grad_scale is not None:
+                # fault injection BEFORE scaling/clipping/compression:
+                # the probe must see nonfinite GRADS, exactly as a real
+                # divergence would present
+                grads = {k: g * grad_scale for k, g in grads.items()}
             if cdt is not None:
                 grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
             # per-layer scales & freeze
@@ -405,7 +420,7 @@ class TrainStep:
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
     # -- host API ----------------------------------------------------------
-    def run(self, x, y, key) -> float:
+    def run(self, x, y, key, grad_scale=None) -> float:
         """One training iteration; returns the loss.
 
         Single-host callers pass the GLOBAL batch; multi-host callers pass
@@ -418,9 +433,9 @@ class TrainStep:
         # set only once run_sharded is definitely next — names both the
         # hooks cache event and the telemetry compile event after it
         self._dispatch_observed = "TrainStep.run"
-        return self.run_sharded(x, y, key)
+        return self.run_sharded(x, y, key, grad_scale=grad_scale)
 
-    def run_sharded(self, x, y, key):
+    def run_sharded(self, x, y, key, grad_scale=None):
         """One iteration over batch arrays already placed on the mesh
         (``_shard_batch``) — lets the host loop time the h2d transfer and
         the dispatch as separate Metrics stages."""
@@ -441,8 +456,14 @@ class TrainStep:
         tracer = _telemetry.get()
         before = _jit_cache_size(self._compiled) if tracer else None
         t0 = time.perf_counter()
-        out = self._compiled(
-            self.params, self.opt_state, self.buffers, x, y, key)
+        args = (self.params, self.opt_state, self.buffers, x, y, key)
+        if self.grad_fault:
+            # always pass the scalar once armed — a consistent arity
+            # keeps one executable (the scalar is a traced input, so
+            # 1.0 vs NaN cannot retrace)
+            args += (jnp.float32(1.0 if grad_scale is None
+                                 else grad_scale),)
+        out = self._compiled(*args)
         if self.health_probe:
             (self.params, self.opt_state, self.buffers, loss,
              self.last_health) = out
@@ -472,8 +493,10 @@ class TrainStep:
         if level == "off":
             return
         try:
-            lowered = self._compiled.lower(
-                self.params, self.opt_state, self.buffers, x, y, key)
+            largs = (self.params, self.opt_state, self.buffers, x, y, key)
+            if self.grad_fault:
+                largs += (jnp.float32(1.0),)
+            lowered = self._compiled.lower(*largs)
             facts = _tdev.collect_device_facts(
                 lowered, (self.params, self.opt_state, self.buffers),
                 level=level)

@@ -64,6 +64,13 @@ class MetricsSink:
         self.compiles = 0
         self.retraces = 0
         self.nonfinite_steps = 0
+        # fault-tolerance state (docs/fault_tolerance.md): the watcher
+        # and humans-with-curl read checkpoint freshness and the last
+        # injected fault from /status
+        self.checkpoint: Dict[str, Any] = {}  # last checkpoint/saved
+        self.last_fault: Dict[str, Any] = {}  # last fault/injected
+        self.quarantined = 0
+        self.preempted = False
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -92,6 +99,21 @@ class MetricsSink:
             elif kind == "event":
                 name = str(event.get("name", "?"))
                 self.events[name] = self.events.get(name, 0) + 1
+                if name == "checkpoint/saved":
+                    self.checkpoint = {
+                        "step": event.get("step"),
+                        "backend": event.get("backend"),
+                        "saved_at": event.get("ts")}
+                elif name == "fault/injected":
+                    self.last_fault = {
+                        "fault": event.get("fault"),
+                        "step": event.get("step"),
+                        "point": event.get("point"),
+                        "at": event.get("ts")}
+                elif name == "checkpoint/quarantined":
+                    self.quarantined += 1
+                elif name == "run/preempted":
+                    self.preempted = True
             elif kind == "compile":
                 self.compiles += 1
             elif kind == "retrace":
@@ -106,6 +128,10 @@ class MetricsSink:
     # -- views -------------------------------------------------------------
     def status(self) -> Dict[str, Any]:
         with self._lock:
+            checkpoint = dict(self.checkpoint)
+            if checkpoint.get("saved_at"):
+                checkpoint["age_s"] = round(
+                    time.time() - float(checkpoint["saved_at"]), 3)
             return {"uptime_s": round(time.time() - self._t0, 3),
                     "process_index": self.meta.get("process_index", 0),
                     "process_count": self.meta.get("process_count", 1),
@@ -115,7 +141,11 @@ class MetricsSink:
                     "counters": dict(self.counters),
                     "gauges": dict(self.gauges),
                     "compiles": self.compiles, "retraces": self.retraces,
-                    "nonfinite_steps": self.nonfinite_steps}
+                    "nonfinite_steps": self.nonfinite_steps,
+                    "checkpoint": checkpoint,
+                    "last_fault": dict(self.last_fault),
+                    "quarantined_checkpoints": self.quarantined,
+                    "preempted": self.preempted}
 
     def openmetrics(self) -> str:
         """Prometheus/OpenMetrics exposition text of the current state."""
@@ -162,6 +192,15 @@ class MetricsSink:
                            self.health[key], f"latest probe {key}")
             sample("bigdl_health_nonfinite_steps_total", "counter",
                    self.nonfinite_steps, "steps with any nonfinite probe")
+            if self.checkpoint.get("saved_at"):
+                sample("bigdl_checkpoint_last_step", "gauge",
+                       self.checkpoint.get("step"),
+                       "step of the newest committed checkpoint")
+                sample("bigdl_checkpoint_age_seconds", "gauge",
+                       time.time() - float(self.checkpoint["saved_at"]),
+                       "seconds since the newest committed checkpoint")
+            sample("bigdl_checkpoints_quarantined_total", "counter",
+                   self.quarantined, "torn checkpoints quarantined")
             sample("bigdl_compiles_total", "counter", self.compiles,
                    "XLA compiles observed")
             sample("bigdl_retraces_total", "counter", self.retraces,

@@ -67,6 +67,11 @@ STREAM_NAMES = frozenset({
     "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
     "metrics/serving", "profile/armed", "profile/captured",
     "flight/dump",
+    # fault tolerance (bigdl_tpu/faults.py + docs/fault_tolerance.md):
+    # injected faults, quarantined torn checkpoints, graceful
+    # preemption, and checkpoint auto-resume
+    "fault/injected", "checkpoint/quarantined", "run/preempted",
+    "run/resumed",
     # health findings (telemetry/health.py detectors + policy)
     "health/nonfinite", "health/skip", "health/loss_spike",
     "health/plateau", "health/grad_explosion", "health/halt",

@@ -39,6 +39,10 @@ test (or an embedding application) can inject overrides with
 | log_thirdparty         | BIGDL_LOG_THIRDPARTY        | redirect third-party logs to file |
 | prefetch_batches       | BIGDL_PREFETCH              | Optimizer input double-buffering depth (0 = sync) |
 | async_checkpoint       | BIGDL_ASYNC_CHECKPOINT      | overlap checkpoint IO with training (default on) |
+| retry_backoff          | BIGDL_RETRY_BACKOFF         | retry-loop backoff base seconds (exp + jitter, cap 30s; 0 = off) |
+| resume                 | BIGDL_RESUME                | auto-resume from the checkpoint dir: auto / off (docs/fault_tolerance.md) |
+| faults                 | BIGDL_FAULTS                | deterministic fault-injection plan (bigdl_tpu/faults.py) |
+| faults_seed            | BIGDL_FAULTS_SEED           | seed for the plan's random choices (torn bytes) |
 
 Performance knobs read directly at their consumer (hardware-tuning
 surface, not part of the typed object because they are read at trace
@@ -113,6 +117,14 @@ class BigDLConfig:
     prefetch_batches: int = 2
     # overlap checkpoint byte-writes with the next training iterations
     async_checkpoint: bool = True
+    # failure-retry backoff base (seconds); exponential with jitter,
+    # capped at 30s; 0 disables the sleep
+    retry_backoff: float = 1.0
+    # auto-resume from the configured checkpoint dir at optimize() start
+    resume: str = "auto"  # auto | off
+    # deterministic fault injection (bigdl_tpu/faults.py); "" = none
+    faults: str = ""
+    faults_seed: int = 0
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -161,6 +173,10 @@ class BigDLConfig:
             prefetch_batches=_int("BIGDL_PREFETCH", 2),
             async_checkpoint=_truthy(
                 env.get("BIGDL_ASYNC_CHECKPOINT") or "true"),
+            retry_backoff=_float("BIGDL_RETRY_BACKOFF", 1.0),
+            resume=(env.get("BIGDL_RESUME") or "auto").strip().lower(),
+            faults=(env.get("BIGDL_FAULTS") or "").strip(),
+            faults_seed=_int("BIGDL_FAULTS_SEED", 0),
         )
 
 

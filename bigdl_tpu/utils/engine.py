@@ -114,6 +114,18 @@ class _Engine:
         cfg = get_config()
         if cfg.coordinator_address is None or self._distributed:
             return
+        try:
+            # a multi-process CPU cluster (the test rig, and any
+            # CPU-fleet deployment) needs a real cross-process
+            # collectives backend — without it every device_put onto a
+            # cross-process sharding dies with "Multiprocess
+            # computations aren't implemented on the CPU backend".
+            # Must be set BEFORE the backend client is created; a no-op
+            # for TPU/GPU platforms, best-effort where the knob or gloo
+            # build is absent.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older jax: knob missing
+            pass
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,

@@ -15,7 +15,7 @@ import os
 import tempfile
 
 __all__ = ["save", "load", "is_remote", "makedirs", "listdir", "exists",
-           "remove", "join"]
+           "isdir", "remove", "rename", "join"]
 
 
 def is_remote(path: str) -> bool:
@@ -118,6 +118,31 @@ def _exists(path: str) -> bool:
 def exists(path: str) -> bool:
     """Local or remote existence check."""
     return _exists(path)
+
+
+def isdir(path: str) -> bool:
+    """Local or remote directory check (object stores answer by
+    prefix)."""
+    if is_remote(path):
+        try:
+            fs, rel = _fs(path)
+            return fs.isdir(rel)
+        except Exception:
+            return False
+    return os.path.isdir(path)
+
+
+def rename(src: str, dst: str):
+    """Rename a file or directory tree, local or remote — the
+    quarantine half of crash-consistent restore (a torn checkpoint is
+    moved aside as ``*.corrupt``, never deleted: it is postmortem
+    evidence)."""
+    if is_remote(src):
+        fs, rel_src = _fs(src)
+        _, rel_dst = _fs(dst)
+        fs.mv(rel_src, rel_dst, recursive=True)
+        return
+    os.replace(src, dst) if os.path.isfile(src) else os.rename(src, dst)
 
 
 def join(path: str, name: str) -> str:

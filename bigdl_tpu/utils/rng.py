@@ -54,6 +54,29 @@ class RandomGenerator:
     def get_seed(self) -> int:
         return self._seed
 
+    def get_state(self) -> dict:
+        """JSON/BTPU-serializable snapshot of the full MT19937 state —
+        checkpoints carry it so a preempted run's resume continues the
+        SAME host-random stream (transform randomness, key draws)
+        instead of replaying or forking it."""
+        with self._lock:
+            st = self._gen.bit_generator.state
+            return {"seed": self._seed,
+                    "key": [int(v) for v in st["state"]["key"]],
+                    "pos": int(st["state"]["pos"])}
+
+    def set_state(self, state: dict) -> "RandomGenerator":
+        """Restore a :meth:`get_state` snapshot (checkpoint resume)."""
+        with self._lock:
+            self._seed = int(state.get("seed", self._seed))
+            gen = np.random.Generator(np.random.MT19937(self._seed))
+            st = gen.bit_generator.state
+            st["state"]["key"] = np.array(state["key"], dtype=np.uint32)
+            st["state"]["pos"] = int(state["pos"])
+            gen.bit_generator.state = st
+            self._gen = gen
+        return self
+
     def uniform(self, a: float = 0.0, b: float = 1.0, size=None) -> np.ndarray:
         with self._lock:
             return self._gen.uniform(a, b, size=size)

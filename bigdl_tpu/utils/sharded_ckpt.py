@@ -36,18 +36,29 @@ barrier as the BTPU backend.
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from bigdl_tpu.utils import ckpt_digest
 from bigdl_tpu.utils import file as File
 
 __all__ = ["save_train_step", "restore_train_step", "latest_step_dir",
-           "prune_old"]
+           "latest_verified_step_dir", "verify_step_dir", "quarantine",
+           "prune_old", "CorruptCheckpointError"]
 
 _META = "bigdl_meta.json"
+
+log = logging.getLogger("bigdl_tpu.ckpt")
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint's content digests do not match its payload — it is
+    torn or bit-rotted and must not be loaded (restore quarantines it
+    and falls back to the previous good step)."""
 
 #: process-lifetime checkpointer — orbax serializes saves per instance,
 #: so one shared instance gives in-order async writes for free
@@ -119,9 +130,18 @@ def save_train_step(step, path: str, extra: Optional[Dict] = None,
     def finish():
         ckptr.wait_until_finished()
         if _is_coordinator():
-            meta = {"extra": extra or {}}
+            # digest the payload AFTER the write is durable: the meta
+            # marker then certifies both completeness (it exists) and
+            # integrity (the digests match) — restore verifies before
+            # any state is touched
+            digests = ckpt_digest.digest_dir(path, exclude=(_META,))
+            meta = {"extra": extra or {}, "digests": digests}
             File.save(json.dumps(meta).encode(), _join(path, _META),
                       overwrite=True)
+        # fault injection (bigdl_tpu/faults.py): a torn_ckpt plan entry
+        # corrupts a committed shard NOW — marker valid, payload torn —
+        # which is precisely the failure the digests exist to catch
+        _poll_torn_fault(path, extra)
 
     if wait:
         finish()
@@ -129,39 +149,112 @@ def save_train_step(step, path: str, extra: Optional[Dict] = None,
     return finish
 
 
+def _poll_torn_fault(path: str, extra: Optional[Dict]) -> None:
+    """Give the fault plan its post-commit shot at this checkpoint.
+    Coordinator-only: a torn file is a storage event with ONE writer —
+    every process XOR-flipping the same seeded bytes on a shared dir
+    would undo the tear on the second pass (and race the writes)."""
+    try:
+        from bigdl_tpu import faults
+
+        plan = faults.get_plan()
+        if plan.has("torn_ckpt") and _is_coordinator() \
+                and not File.is_remote(path):
+            driver = (extra or {}).get("driver_state", {})
+            step_no = int(driver.get("neval", (extra or {}).get("neval", 0)))
+            plan.poll_checkpoint(path, step_no)
+    except Exception:  # noqa: BLE001 - injection must not fail a save
+        log.warning("torn_ckpt fault injection failed", exc_info=True)
+
+
+def _read_meta(path: str) -> Optional[Dict]:
+    try:
+        return json.loads(File.load(_join(path, _META)))
+    except (OSError, ValueError):
+        return None
+
+
+def verify_step_dir(path: str) -> Tuple[bool, List[str]]:
+    """Integrity check of one checkpoint directory: the meta marker must
+    parse and every recorded digest must match the payload on disk.
+    Metas without digests (pre-digest checkpoints) pass as complete but
+    unverifiable — rejecting them would strand every existing
+    checkpoint."""
+    meta = _read_meta(_resolve(path))
+    if meta is None:
+        return False, ["meta marker missing or unparseable"]
+    digests = meta.get("digests")
+    if not digests:
+        return True, []
+    problems = ckpt_digest.verify_digests(_resolve(path), digests)
+    return not problems, problems
+
+
+def quarantine(path: str, problems: Optional[List[str]] = None) -> str:
+    """Move a torn/corrupt checkpoint aside as ``<path>.corrupt`` (kept
+    as postmortem evidence, and so discovery can never pick it again),
+    announce it (``checkpoint/quarantined`` instant + flight-recorder
+    ring), and return the new path."""
+    from bigdl_tpu import telemetry
+
+    path = _resolve(path)
+    dest = path.rstrip("/") + ".corrupt"
+    n = 1
+    while File.exists(dest):
+        dest = path.rstrip("/") + f".corrupt.{n}"
+        n += 1
+    File.rename(path, dest)
+    log.error(f"[Checkpoint] quarantined {path} -> {dest}: "
+              f"{'; '.join(problems or ['integrity check failed'])}")
+    telemetry.instant("checkpoint/quarantined", path=path, moved_to=dest,
+                      problems=list(problems or []))
+    return dest
+
+
 def restore_train_step(step, path: str) -> Dict:
     """Restore into ``step`` IN PLACE, preserving the live shardings
     (each leaf restores against the step's current array as the abstract
     target, so placement follows the current mesh).  Returns the saved
-    ``extra`` dict."""
+    ``extra`` dict.
+
+    Content digests recorded at save time are verified FIRST — a torn
+    or bit-flipped checkpoint raises :class:`CorruptCheckpointError`
+    before any of the step's state is touched, so a failed restore can
+    never leave the step half-loaded."""
     path = _resolve(path)
-    target = _sanitize(_tree(step))
     ckptr = _checkpointer()
     ckptr.wait_until_finished()  # never race an in-flight save
+    ok, problems = verify_step_dir(path)
+    if not ok:
+        raise CorruptCheckpointError(
+            f"checkpoint {path} failed integrity verification: "
+            f"{'; '.join(problems)}")
+    target = _sanitize(_tree(step))
     restored = ckptr.restore(_join(path, "state"), target)
     step.params = restored["params"]
     step.opt_state = restored["opt_state"]
     step.buffers = restored["buffers"]
-    try:
-        return json.loads(File.load(_join(path, _META))).get("extra", {})
-    except OSError:
-        return {}
+    meta = _read_meta(path)
+    return (meta or {}).get("extra", {})
 
 
 def _numbered(root: str, prefix: str) -> List[tuple]:
     """``(n, path)`` for every complete ``<prefix>.<n>`` checkpoint under
-    ``root`` (meta marker present), local or remote."""
+    ``root`` (meta marker present), local or remote.  The match is
+    EXACT — ``<prefix>.<n>`` and nothing more — so a quarantined
+    ``<prefix>.<n>.corrupt[.k]`` (which still contains the meta marker)
+    can never re-enter discovery as a checkpoint."""
+    import re
+
+    pat = re.compile(re.escape(prefix) + r"\.(\d+)")
     out = []
     for name in File.listdir(root):
-        if not name.startswith(prefix + "."):
-            continue
-        try:
-            n = int(name.rsplit(".", 1)[1])
-        except ValueError:
+        m = pat.fullmatch(name)
+        if m is None:
             continue
         p = _join(root, name)
         if File.exists(_join(p, _META)):
-            out.append((n, p))
+            out.append((int(m.group(1)), p))
     return out
 
 
@@ -173,16 +266,58 @@ def latest_step_dir(root: str, prefix: str = "sharded") -> Optional[str]:
     return max(done)[1] if done else None
 
 
-def prune_old(root: str, keep: int, prefix: str = "sharded") -> List[str]:
+def latest_verified_step_dir(root: str, prefix: str = "sharded",
+                             do_quarantine: bool = True) -> Optional[str]:
+    """Newest complete checkpoint that also passes digest verification.
+    Candidates that fail are quarantined (``*.corrupt``) on the way down
+    so discovery converges — the caller gets the newest GOOD step or
+    None, never a torn one."""
+    for _n, p in sorted(_numbered(root, prefix), reverse=True):
+        ok, problems = verify_step_dir(p)
+        if ok:
+            return p
+        if do_quarantine:
+            try:
+                quarantine(p, problems)
+            except OSError:
+                log.error(f"[Checkpoint] could not quarantine {p}")
+    return None
+
+
+def prune_old(root: str, keep: int, prefix: str = "sharded",
+              trusted: Optional[str] = None) -> List[str]:
     """Delete all but the newest ``keep`` complete checkpoints under
     ``root``; returns the pruned paths.  Retention policy the reference
     lacks (its ``model.n`` files accumulate forever) but pod-scale
-    sharded state demands."""
+    sharded state demands.
+
+    The newest VERIFIED-good checkpoint is never deleted, even when it
+    falls outside the keep window — if every newer checkpoint turns out
+    torn, it is the only state a restore can still fall back to.
+    ``trusted`` names a checkpoint the caller certifies as good (the
+    one it JUST wrote and digested) so the retention guard need not
+    re-read and re-hash it on every save."""
     if keep < 1:
         raise ValueError("keep must be >= 1")
     done = sorted(_numbered(root, prefix))
+    victims = done[:-keep]
+    if victims:
+        # the newest survivor that verifies makes every victim safe to
+        # drop (trusted short-circuit, then newest-first early exit);
+        # otherwise retain the newest verifying victim as the fallback
+        # anchor
+        trusted = _resolve(trusted) if trusted else None
+        if not any(p == trusted or verify_step_dir(p)[0]
+                   for _n, p in sorted(done[-keep:], reverse=True)):
+            for item in sorted(victims, reverse=True):
+                if verify_step_dir(item[1])[0]:
+                    victims = [v for v in victims if v != item]
+                    log.warning(
+                        f"[Checkpoint] retaining {item[1]} beyond keep="
+                        f"{keep}: it is the last verified-good checkpoint")
+                    break
     pruned = []
-    for _, p in done[:-keep]:
+    for _, p in victims:
         File.remove(p)
         pruned.append(p)
     return pruned

@@ -72,11 +72,15 @@ def main():
     y = rng.randint(0, 4, 64)
     samples = [Sample(x[i], y[i]) for i in range(64)]
 
-    # 4 iterations x global batch 16 = exactly one epoch (no shuffle yet),
-    # so cluster and control runs see identical global batch CONTENTS
+    # default: 4 iterations x global batch 16 = exactly one epoch (no
+    # shuffle yet), so cluster and control runs see identical global
+    # batch CONTENTS.  The fault/preemption tests run longer
+    # (BIGDL_TEST_ITERS) — epoch ordering stays comparable across runs
+    # because later epochs shuffle deterministically by (seed, epoch).
+    iters = int(os.environ.get("BIGDL_TEST_ITERS", "4"))
     o = optim.Optimizer(model=model, dataset=samples,
                         criterion=nn.ClassNLLCriterion(), batch_size=16,
-                        end_trigger=optim.Trigger.max_iteration(4))
+                        end_trigger=optim.Trigger.max_iteration(iters))
     o.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
     if os.environ.get("BIGDL_TEST_ZERO1"):
         o.set_parameter_sync("sharded")
@@ -90,9 +94,20 @@ def main():
                          batch_size=8)
     ckpt = os.environ.get("BIGDL_TEST_CKPT")
     if ckpt:
-        o.set_checkpoint(ckpt, optim.Trigger.every_epoch())
+        every = int(os.environ.get("BIGDL_TEST_CKPT_EVERY", "0"))
+        trigger = optim.Trigger.several_iteration(every) if every \
+            else optim.Trigger.every_epoch()
+        o.set_checkpoint(ckpt, trigger)
         o.overwrite_checkpoint()
     trained = o.optimize()
+
+    if o.preempted:
+        # graceful preemption: final checkpoint committed, exit 0; the
+        # restarted cluster resumes and writes the params — a preempted
+        # run must NOT publish mid-run params as final
+        print(f"worker {Engine.process_index()}/{Engine.process_count()} "
+              f"preempted at iteration {o.state['neval']}", flush=True)
+        return
 
     if Engine.is_coordinator():
         from bigdl_tpu.nn.module import state_dict

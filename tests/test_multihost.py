@@ -38,9 +38,14 @@ def _worker_env(**extra) -> dict:
     return env
 
 
-def _run_cluster(tmp_path, tag: str, nproc: int = 2, **extra) -> str:
+def _run_cluster(tmp_path, tag: str, nproc: int = 2, expect_out: bool = True,
+                 timeout: int = 420, **extra) -> str:
     """Run the worker on an ``nproc``-process cluster; return the
-    coordinator's saved-params path."""
+    coordinator's saved-params path.  ``expect_out=False`` for runs that
+    legitimately end without publishing params (graceful preemption).
+    The generous default ``timeout`` is deliberate: these tests spin
+    real jax.distributed clusters and must stay green on loaded CI
+    machines (deflake budget, ISSUE 5)."""
     port = _free_port()
     out = str(tmp_path / f"{tag}.npz")
     procs = [
@@ -55,7 +60,7 @@ def _run_cluster(tmp_path, tag: str, nproc: int = 2, **extra) -> str:
     outputs = []
     try:
         for p in procs:
-            stdout, _ = p.communicate(timeout=420)
+            stdout, _ = p.communicate(timeout=timeout)
             outputs.append(stdout.decode(errors="replace"))
     finally:
         for p in procs:  # a hung collective must not leak live workers
@@ -64,7 +69,8 @@ def _run_cluster(tmp_path, tag: str, nproc: int = 2, **extra) -> str:
                 p.wait()
     for p, text in zip(procs, outputs):
         assert p.returncode == 0, f"cluster worker failed:\n{text[-4000:]}"
-    assert os.path.exists(out), "coordinator did not write params"
+    if expect_out:
+        assert os.path.exists(out), "coordinator did not write params"
     return out
 
 
@@ -155,6 +161,42 @@ def test_engine_single_process_defaults():
     assert Engine.process_index() == 0
     assert Engine.is_coordinator()
     assert len(Engine.local_devices()) == Engine.device_count()
+
+
+def test_two_process_preempt_resume_matches_uninterrupted(tmp_path):
+    """The ISSUE 5 acceptance path: SIGTERM mid-run on the 2-process
+    cluster, restart the cluster, and the resumed run's final params
+    equal an uninterrupted run's — byte-for-byte training continuity
+    across a preemption boundary.
+
+    The SIGTERM is delivered by the fault plan (``preempt@6``: every
+    worker signals ITSELF at the start of iteration 6 — the shape of a
+    TPU-slice preemption notice, where every host gets the signal), so
+    the kill lands mid-epoch-2 deterministically instead of racing the
+    test harness against the training loop.  The grace handler finishes
+    iteration 6, commits a final checkpoint whose meta carries the
+    dataset/epoch position + RNG state, and the workers exit 0 WITHOUT
+    publishing params.  The restarted cluster auto-resumes from that
+    checkpoint, fast-forwards 32 records into epoch 2, and runs
+    iterations 7 and 8."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    base = dict(BIGDL_TEST_ITERS=8, BIGDL_TEST_CKPT_EVERY=4)
+    # 8 iterations x global batch 16 over 64 records = 2 epochs; epoch 2
+    # is SHUFFLED (deterministically by (seed, epoch)) so the resume
+    # must reproduce the mid-epoch order, not just a fresh epoch
+    un = _run_cluster(tmp_path, "preempt_un",
+                      BIGDL_TEST_CKPT=str(tmp_path / "ckpt_un"), **base)
+    pre = _run_cluster(tmp_path, "preempt_pre", expect_out=False,
+                       BIGDL_TEST_CKPT=str(ckpt),
+                       BIGDL_FAULTS="preempt@6", **base)
+    assert not os.path.exists(pre), "preempted run must not publish params"
+    # final checkpoint landed at the preempted iteration
+    assert any(f.startswith("model.6") for f in os.listdir(ckpt)), \
+        sorted(os.listdir(ckpt))
+    resumed = _run_cluster(tmp_path, "preempt_res",
+                           BIGDL_TEST_CKPT=str(ckpt), **base)
+    _assert_same_params(resumed, un)
 
 
 def test_two_process_sharded_validation_matches_full(tmp_path):

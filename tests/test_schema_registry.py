@@ -16,6 +16,24 @@ from bigdl_tpu.telemetry import schema
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: reliability-critical modules the registry pins alongside the CI lint
+#: (tools/lint_graft.py PINNED_MODULES) — a rename/removal must fail
+#: tests, not silently drop the subsystem from the lexical scan
+PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
+          "bigdl_tpu/utils/sharded_ckpt.py"]
+
+
+def test_pinned_fault_tolerance_modules_present():
+    missing = [m for m in PINNED
+               if not os.path.isfile(os.path.join(REPO, m))]
+    assert missing == [], (
+        f"pinned modules missing: {missing} — fault injection and "
+        f"crash-consistent restore are load-bearing (ISSUE 5); update "
+        f"the pins if these moved")
+    from tools.lint_graft import check_pins
+
+    assert check_pins(REPO) == []
+
 #: literal emit kinds: tracer.emit("<kind>", ...)
 _KIND_RE = re.compile(r'\.emit\(\s*"(\w+)"')
 #: literal stream names through the typed helpers
@@ -72,8 +90,10 @@ def test_every_emitted_kind_is_registered():
 def test_every_emitted_stream_name_is_registered():
     _, names = _scan()
     assert {"train/iteration", "data_wait", "straggler/timeout",
-            "prefetch/queue_depth", "profile/armed",
-            "flight/dump"} <= names, "name scan lost its anchors"
+            "prefetch/queue_depth", "profile/armed", "flight/dump",
+            "fault/injected", "checkpoint/quarantined",
+            "run/preempted", "run/resumed"} <= names, \
+        "name scan lost its anchors"
     unregistered = sorted(names - set(schema.STREAM_NAMES))
     assert unregistered == [], (
         f"stream names emitted but not in schema.STREAM_NAMES: "

@@ -24,6 +24,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bigdl_tpu.analysis.ast_lint import DEFAULT_LINT_DIRS, lint_paths  # noqa: E402
 
+#: modules the CI gate PINS: reliability-critical subsystems whose
+#: accidental deletion/rename must fail the build, not pass it silently
+#: (the default-dir lint would simply stop seeing a removed file)
+PINNED_MODULES = [
+    "bigdl_tpu/faults.py",
+    "bigdl_tpu/utils/ckpt_digest.py",
+    "bigdl_tpu/utils/sharded_ckpt.py",
+    "bigdl_tpu/telemetry/schema.py",
+    "bigdl_tpu/telemetry/flight.py",
+    "bigdl_tpu/telemetry/metrics_http.py",
+]
+
+
+def check_pins(repo: str) -> list:
+    """Missing pinned modules (empty = all present)."""
+    return [m for m in PINNED_MODULES
+            if not os.path.isfile(os.path.join(repo, m))]
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
@@ -39,6 +57,10 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    missing = check_pins(repo)
+    if missing:
+        print(f"pinned modules missing: {', '.join(missing)}")
+        return 1
     paths = args.paths or [os.path.join(repo, d) for d in DEFAULT_LINT_DIRS]
     report = lint_paths(paths, suppress=args.suppress)
     print(report.format())

@@ -59,6 +59,19 @@ elif prof.get("last_trace_dir"):
 flight = st.get("flight") or {}
 if flight.get("last_dump_path"):
     line += f" flight_dump={flight['last_dump_path']}"
+# fault tolerance (docs/fault_tolerance.md): checkpoint freshness and
+# the last injected fault — a babysitter sees at a glance whether the
+# run is checkpointing on cadence and whether a fault plan has fired
+ckpt = st.get("checkpoint") or {}
+if ckpt.get("saved_at"):
+    line += f" ckpt=step{ckpt.get('step', '?')}@{ckpt.get('age_s', '?')}s"
+fault = st.get("last_fault") or {}
+if fault.get("fault"):
+    line += f" last_fault={fault['fault']}@{fault.get('step', '?')}"
+if st.get("quarantined_checkpoints"):
+    line += f" quarantined={st['quarantined_checkpoints']}"
+if st.get("preempted"):
+    line += " PREEMPTED"
 print(line)
 PY
 }
