@@ -218,10 +218,13 @@ def _flash_attn_flops(name, batch):
     geom = ATTN_GEOM.get(name)
     if not geom:
         return 0.0
-    from bigdl_tpu.ops.attention import flash_min_seq, is_tpu_device
+    # THE routing predicate, shared with MultiHeadAttention (round-5
+    # advisor: re-deriving it here silently drifted when the rule or
+    # the BIGDL_KERNELS knob changed it)
+    from bigdl_tpu.ops.attention import flash_auto
 
     layers, heads, d, s = geom
-    if not (is_tpu_device() and s >= flash_min_seq()):
+    if not flash_auto(s, s):
         return 0.0  # dense path: cost analysis already counts it
     return 12.0 * layers * batch * heads * float(s) * s * d
 

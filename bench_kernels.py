@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Per-op kernel micro-benchmark: Pallas leg vs XLA reference leg.
+
+Times every op in the kernel library (bigdl_tpu/ops/) forward and
+forward+backward under ``BIGDL_KERNELS=pallas`` and ``=xla`` on
+representative model geometries (inception LRN/pool planes, contrastive
+front-end, transformer attention), and emits a BENCH_*-style JSON whose
+``configs`` table is comparable by ``python -m bigdl_tpu.telemetry
+diff`` / ``bench.py --diff-against`` (rows carry ``images_per_sec`` =
+op executions per second on the preferred leg, so cross-round kernel
+regressions gate exactly like model throughput).
+
+On TPU the pallas column is the Mosaic-compiled kernel and the speedup
+column is the number that justifies ``auto`` routing.  Off-TPU the
+pallas leg runs the INTERPRETER — a correctness reference, not a perf
+claim — and the JSON says so (``pallas_is_interpret: true``); use
+``--skip-pallas`` to record an XLA-only baseline quickly.
+
+Usage::
+
+    python bench_kernels.py                       # all ops, default reps
+    python bench_kernels.py --ops lrn_cross_map,pool_avg_ceil --repeat 20
+    python bench_kernels.py --small               # CI-sized shapes
+    python bench_kernels.py -o BENCH_KERNELS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _geoms(small: bool):
+    """(op -> (builder, shape, static)) on bench geometries; --small
+    shrinks planes so the CPU interpreter finishes in CI time."""
+    if small:
+        lrn = (2, 8, 8, 8)
+        norm = (2, 3, 12, 12)
+        pool = (2, 8, 9, 9)
+        attn = (1, 2, 128, 32)
+    else:
+        # inception-v1's LRN sits on [N, 64, 56, 56]; the contrastive
+        # front-end on 3-channel planes; pool3x3/s2 ceil everywhere
+        lrn = (8, 64, 28, 28)
+        norm = (8, 3, 56, 56)
+        pool = (8, 64, 28, 28)
+        attn = (2, 8, 512, 64)
+    return {"lrn": lrn, "norm": norm, "pool": pool, "attn": attn}
+
+
+def _build_cases(small: bool):
+    from bigdl_tpu.nn.layers.normalization import _gaussian_kernel
+    from bigdl_tpu.ops.lrn_pallas import cross_map_lrn, within_channel_lrn
+    from bigdl_tpu.ops.norm_pallas import (contrastive_norm,
+                                           divisive_norm,
+                                           subtractive_norm)
+    from bigdl_tpu.ops.pool_pallas import avg_pool, maxpool_tie_split
+    from bigdl_tpu.ops.attention import (dot_product_attention,
+                                         flash_attention)
+
+    g = _geoms(small)
+    gauss = jnp.asarray(_gaussian_kernel(9))
+    pdims, pstr = (1, 1, 3, 3), (1, 1, 2, 2)
+    ppads = ((0, 0), (0, 0), (1, 2), (1, 2))       # ceil-mode overflow
+    pdecl = ((0, 0), (0, 0), (1, 1), (1, 1))
+
+    cases = {
+        "lrn_cross_map": (
+            lambda x: cross_map_lrn(x, 5, 1e-4, 0.75, 1.0), g["lrn"]),
+        "lrn_within_channel": (
+            lambda x: within_channel_lrn(x, 5, 1e-4, 0.75), g["lrn"]),
+        "norm_subtractive": (
+            lambda x: subtractive_norm(x, gauss), g["norm"]),
+        "norm_divisive": (
+            lambda x: divisive_norm(x, gauss), g["norm"]),
+        "norm_contrastive": (
+            lambda x: contrastive_norm(x, gauss), g["norm"]),
+        "pool_tie_split": (
+            lambda x: maxpool_tie_split(x, pdims, pstr, ppads),
+            g["pool"]),
+        "pool_avg_ceil": (
+            lambda x: avg_pool(x, pdims, pstr, ppads, pdecl, True, True),
+            g["pool"]),
+    }
+
+    b, h, s, d = g["attn"]
+
+    def _attn(kind):
+        def run(qkv):
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            if kind == "flash":
+                return flash_attention(q, k, v, causal=True)
+            return dot_product_attention(q, k, v, causal=True)
+        return run
+
+    # attention is special-cased: its two legs are distinct entry
+    # points, not a dispatch inside one op
+    cases["attention"] = ((_attn("dense"), _attn("flash")),
+                          (3, b, h, s, d))
+    return cases
+
+
+def _time_one(fn, x, repeat: int, grad: bool):
+    if grad:
+        def loss(a):
+            return jnp.sum(fn(a) ** 2)
+        run = jax.jit(jax.grad(loss))
+    else:
+        run = jax.jit(fn)
+    out = run(x)
+    jax.block_until_ready(out)          # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = run(x)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / repeat
+
+
+def bench_op(name, case, repeat: int, skip_pallas: bool):
+    fn, shape = case
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    row = {"shape": list(shape), "dtype": "float32", "repeat": repeat}
+    legs = {}
+    for leg in ("xla",) if skip_pallas else ("xla", "pallas"):
+        if isinstance(fn, tuple):       # attention: explicit entry points
+            leg_fn = fn[0] if leg == "xla" else fn[1]
+            os.environ["BIGDL_KERNELS"] = "auto"
+        else:
+            leg_fn = fn
+            os.environ["BIGDL_KERNELS"] = leg
+        legs[leg] = {
+            "fwd_ms": _time_one(leg_fn, x, repeat, grad=False) * 1e3,
+            "fwdbwd_ms": _time_one(leg_fn, x, repeat, grad=True) * 1e3,
+        }
+    for leg, t in legs.items():
+        row[f"{leg}_fwd_ms"] = round(t["fwd_ms"], 4)
+        row[f"{leg}_fwdbwd_ms"] = round(t["fwdbwd_ms"], 4)
+    if "pallas" in legs:
+        row["speedup_fwd"] = round(
+            legs["xla"]["fwd_ms"] / legs["pallas"]["fwd_ms"], 3)
+        row["speedup_fwdbwd"] = round(
+            legs["xla"]["fwdbwd_ms"] / legs["pallas"]["fwdbwd_ms"], 3)
+    # comparable key for telemetry diff: executions/sec of the leg the
+    # auto policy would run on THIS device (pallas on TPU, xla off-TPU)
+    from bigdl_tpu.ops.attention import is_tpu_device
+
+    pref = "pallas" if (is_tpu_device() and "pallas" in legs) else "xla"
+    row["preferred_leg"] = pref
+    row["images_per_sec"] = round(1e3 / legs[pref]["fwdbwd_ms"], 2)
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-op Pallas-vs-XLA kernel micro-benchmark")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--repeat", type=int, default=10)
+    ap.add_argument("--small", action="store_true",
+                    help="CI-sized shapes (CPU interpreter budget)")
+    ap.add_argument("--skip-pallas", action="store_true",
+                    help="XLA-only baseline (skip the interpret leg)")
+    ap.add_argument("-o", "--output", default=None, metavar="OUT.json")
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.ops.attention import is_tpu_device
+
+    prev = os.environ.get("BIGDL_KERNELS")
+    cases = _build_cases(args.small)
+    if args.ops:
+        wanted = [s.strip() for s in args.ops.split(",") if s.strip()]
+        unknown = sorted(set(wanted) - set(cases))
+        if unknown:
+            ap.error(f"unknown ops: {', '.join(unknown)} "
+                     f"(have: {', '.join(sorted(cases))})")
+        cases = {k: cases[k] for k in wanted}
+
+    dev = jax.devices()[0]
+    configs = {}
+    try:
+        for name, case in cases.items():
+            configs[name] = bench_op(name, case, args.repeat,
+                                     args.skip_pallas)
+            print(f"{name:22s} " + " ".join(
+                f"{k}={v}" for k, v in configs[name].items()
+                if k.endswith("_ms") or k.startswith("speedup")))
+    finally:                            # never leak the knob
+        if prev is None:
+            os.environ.pop("BIGDL_KERNELS", None)
+        else:
+            os.environ["BIGDL_KERNELS"] = prev
+
+    speed = [r["speedup_fwdbwd"] for r in configs.values()
+             if "speedup_fwdbwd" in r]
+    doc = {
+        "metric": "kernel_microbench_speedup_geomean",
+        "value": round(float(np.exp(np.mean(np.log(speed)))), 3)
+        if speed else None,
+        "unit": "x (xla_ms / pallas_ms, fwd+bwd)",
+        "device": getattr(dev, "device_kind", str(dev)),
+        "pallas_is_interpret": not is_tpu_device(),
+        "configs": configs,
+    }
+    out = json.dumps(doc, indent=1)
+    print(out)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -109,19 +109,20 @@ class MultiHeadAttention(Module):
                 "backend='flash' does not support masks (only causal=True); "
                 "use backend='dense' or 'auto' for masked attention")
         if backend == "auto":
-            from bigdl_tpu.ops.attention import flash_min_seq, is_tpu_device
+            from bigdl_tpu.ops.attention import select_attention_backend
+            from bigdl_tpu.ops.dispatch import note
 
             # dense below the threshold, flash at/above it.  With the
             # round-5 block defaults (1024/512) flash BEATS dense from
             # seq 512 up (exp_attention_backend: 734 vs 562 seq/s — the
             # earlier "flash was 53% of the seq-512 step" profile was an
-            # artifact of the old 128x128 blocks); judged on BOTH
-            # lengths so a short-query cross-attention over a long k/v
-            # still streams
-            backend = "flash" if (is_tpu_device() and mask is None
-                                  and max(q.shape[2], k.shape[2])
-                                  >= flash_min_seq()) \
-                else "dense"
+            # artifact of the old 128x128 blocks).  The routing rule
+            # itself lives in ops.attention (shared with bench.py's MFU
+            # correction) and honors the BIGDL_KERNELS kill switch.
+            backend, reason = select_attention_backend(
+                q.shape[2], k.shape[2], mask is not None)
+            note("attention",
+                 "pallas" if backend == "flash" else "xla", reason)
         if backend == "flash":
             return flash_attention(q, k, v, causal=self.causal)
         return dot_product_attention(q, k, v, mask=mask, causal=self.causal)

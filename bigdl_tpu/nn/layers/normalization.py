@@ -186,44 +186,27 @@ class SpatialCrossMapLRN(Module):
         self.format = format
 
     def update_output(self, input):
+        # fused kernel-library path (ops/lrn_pallas.py): Pallas or the
+        # XLA banded-conv reference per BIGDL_KERNELS, exact custom VJP
+        # on either leg; NHWC runs the reference natively in its layout
+        from bigdl_tpu.ops.lrn_pallas import cross_map_lrn
+
         squeeze = input.ndim == 3
         x = input[None] if squeeze else input
-        c_ax = 3 if self.format == "NHWC" else 1
-        n_ch = x.shape[c_ax]
-        sq = x * x
-        half = (self.size - 1) // 2
         if x.ndim == 4:
-            # The channel-window sum is a banded C×C matrix applied at every
-            # pixel — expressed as a 1x1 conv so it (and its VJP) run on the
-            # MXU.  A reduce_window over the channel axis profiles ~10x
-            # slower here: the channel dim is non-minor in TPU tiling, and
-            # the window op blocks fusion with the square/scale elementwise.
-            d = np.arange(n_ch)
-            band = ((d[None, :] - d[:, None] >= -half)
-                    & (d[None, :] - d[:, None] <= self.size - 1 - half))
-            if self.format == "NHWC":
-                w = band.astype(np.float32).T[None, None]  # HWIO
-                dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                                ("NHWC", "HWIO", "NHWC"))
-            else:
-                w = band.astype(np.float32)[:, :, None, None]  # OIHW
-                dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                                ("NCHW", "OIHW", "NCHW"))
-            window_sum = lax.conv_general_dilated(
-                sq, jnp.asarray(w, x.dtype), (1, 1), ((0, 0), (0, 0)),
-                dimension_numbers=dn)
-        else:
-            dims, strides, pads = [1] * x.ndim, [1] * x.ndim, [(0, 0)] * x.ndim
-            dims[c_ax] = self.size
-            pads[c_ax] = (half, self.size - 1 - half)
-            window_sum = lax.reduce_window(sq, 0.0, lax.add, tuple(dims),
-                                           tuple(strides), pads)
+            out = cross_map_lrn(x, self.size, self.alpha, self.beta,
+                                self.k, self.format)
+            return out[0] if squeeze else out
+        # rank > 4: generic channel-window reference (no fused kernel)
+        c_ax = x.ndim - 1 if self.format == "NHWC" else 1
+        half = (self.size - 1) // 2
+        dims, strides, pads = [1] * x.ndim, [1] * x.ndim, [(0, 0)] * x.ndim
+        dims[c_ax] = self.size
+        pads[c_ax] = (half, self.size - 1 - half)
+        window_sum = lax.reduce_window(x * x, 0.0, lax.add, tuple(dims),
+                                       tuple(strides), pads)
         scale = self.k + window_sum * (self.alpha / self.size)
-        if self.beta == 0.75:
-            inv = lax.rsqrt(scale)           # scale^-0.5
-            out = x * (inv * jnp.sqrt(inv))  # * scale^-0.25 -> scale^-0.75
-        else:
-            out = x * jnp.power(scale, -self.beta)
+        out = x * jnp.power(scale, -self.beta)
         return out[0] if squeeze else out
 
 
@@ -244,6 +227,15 @@ class SpatialWithinChannelLRN(Module):
         self.size, self.alpha, self.beta = size, alpha, beta
 
     def update_output(self, input):
+        from bigdl_tpu.ops.lrn_pallas import within_channel_lrn
+
+        if input.ndim == 3:
+            return within_channel_lrn(input[None], self.size, self.alpha,
+                                      self.beta)[0]
+        if input.ndim == 4:
+            return within_channel_lrn(input, self.size, self.alpha,
+                                      self.beta)
+        # rank > 4: reference path (no fused kernel / exact VJP)
         half = (self.size - 1) // 2
         dims, strides, pads = [1] * input.ndim, [1] * input.ndim, [(0, 0)] * input.ndim
         for ax in (input.ndim - 2, input.ndim - 1):
@@ -253,21 +245,6 @@ class SpatialWithinChannelLRN(Module):
                                         tuple(dims), tuple(strides), pads) / (self.size * self.size)
         scale = 1.0 + window_mean * self.alpha
         return input * jnp.power(scale, -self.beta)
-
-
-class _KernelSmoother:
-    """Shared helper: depthwise 2-D smoothing with a normalized kernel."""
-
-    @staticmethod
-    def smooth(x, kernel2d, n_plane):
-        k = jnp.asarray(kernel2d)[None, None, :, :]  # (1,1,kh,kw)
-        k = jnp.tile(k, (n_plane, 1, 1, 1))
-        kh, kw = kernel2d.shape
-        dn = lax.conv_dimension_numbers(x.shape, k.shape, ("NCHW", "OIHW", "NCHW"))
-        return lax.conv_general_dilated(
-            x, k.astype(x.dtype), (1, 1),
-            ((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)),
-            dimension_numbers=dn, feature_group_count=n_plane)
 
 
 class SpatialSubtractiveNormalization(Module):
@@ -281,19 +258,14 @@ class SpatialSubtractiveNormalization(Module):
             k = np.outer(k, k)
         self.register_buffer("kernel", k / k.sum())
 
-    def _local_mean(self, x):
-        # mean across channels then smoothed spatially, with edge-coverage
-        # correction (the reference divides by the kernel mass actually inside)
-        mean_in = jnp.mean(x, axis=1, keepdims=True)
-        sm = _KernelSmoother.smooth(mean_in, self.kernel, 1)
-        ones = jnp.ones_like(mean_in)
-        coef = _KernelSmoother.smooth(ones, self.kernel, 1)
-        return sm / coef
-
     def update_output(self, input):
+        from bigdl_tpu.ops.norm_pallas import subtractive_norm
+
         squeeze = input.ndim == 3
         x = input[None] if squeeze else input
-        out = x - self._local_mean(x)
+        # the smoothing kernel is a buffer, never trained: stop_gradient
+        # documents what the op's zero kernel-cotangent already enforces
+        out = subtractive_norm(x, lax.stop_gradient(self.kernel))
         return out[0] if squeeze else out
 
 
@@ -311,17 +283,12 @@ class SpatialDivisiveNormalization(Module):
         self.threshold, self.thresval = threshold, thresval
 
     def update_output(self, input):
+        from bigdl_tpu.ops.norm_pallas import divisive_norm
+
         squeeze = input.ndim == 3
         x = input[None] if squeeze else input
-        mean_sq = jnp.mean(x * x, axis=1, keepdims=True)
-        sm = _KernelSmoother.smooth(mean_sq, self.kernel, 1)
-        ones = jnp.ones_like(mean_sq)
-        coef = _KernelSmoother.smooth(ones, self.kernel, 1)
-        local_std = jnp.sqrt(jnp.clip(sm / coef, 0.0))
-        std_mean = jnp.mean(local_std, axis=(2, 3), keepdims=True)
-        denom = jnp.maximum(local_std, std_mean)
-        denom = jnp.where(denom < self.threshold, self.thresval, denom)
-        out = x / denom
+        out = divisive_norm(x, lax.stop_gradient(self.kernel),
+                            self.threshold, self.thresval)
         return out[0] if squeeze else out
 
 

@@ -12,10 +12,8 @@ declared pad).
 
 from __future__ import annotations
 
-import itertools
 import math
-from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,103 +31,6 @@ def _max_init(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return -jnp.inf
     return jnp.iinfo(dtype).min
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def _maxpool_tie_split(x, dims, strides, pads):
-    """Max pooling with an equal-tie-split backward (opt-in via
-    ``split_ties()``; NOT the default — XLA's native select-and-scatter
-    lowering benches faster on TPU v5e, see the ``_PoolBase.tie_split``
-    note).
-
-    Tie semantics: the gradient is split EQUALLY among tied maxima
-    (gradient mass is conserved), where the reference's CPU loop — and
-    the default select-and-scatter path — sends it to the first argmax
-    (``nn/NNPrimitive.scala:594-972``).  Ties have measure zero for
-    continuous activations, so both paths agree with the Torch oracle on
-    random inputs."""
-    return lax.reduce_window(x, _max_init(x.dtype), lax.max, dims, strides, pads)
-
-
-def _maxpool_fwd(x, dims, strides, pads):
-    y = _maxpool_tie_split(x, dims, strides, pads)
-    return y, (x, y)
-
-
-def _maxpool_taps(xp, off, out_shape, strides):
-    """Strided window tap: element ``off`` of every pooling window."""
-    limits = [o + (n - 1) * s + 1 for o, n, s in zip(off, out_shape, strides)]
-    return lax.slice(xp, off, limits, strides)
-
-
-def _maxpool_bwd(dims, strides, pads, res, gy):
-    """Residue-class gather backward.
-
-    The naive transpose of the tap extraction interior-pads one
-    input-sized tensor per window offset (k*k of them) — profiled at ~50%
-    of the whole Inception-v1 train step on TPU v5e (XLA lowers each
-    interior ``pad`` as a separate strided-write kernel).  Instead, note
-    the padded-input positions split into ``prod(strides)`` residue
-    classes, and within a class the set of windows touching a position is
-    a FIXED number (``ceil(k/s)`` per axis) of plain shifts on the output
-    grid.  So: compute tie weights once on the output grid, gather the
-    overlapping windows' weights per residue class (pure elementwise ops
-    on strided views — XLA fuses each class into one kernel), and write
-    the input-sized gradient exactly once via a depth-to-space
-    interleave (stack + transpose + reshape)."""
-    x, y = res
-    nd = x.ndim
-    zero = jnp.zeros((), gy.dtype)
-    # per-axis: padded extent P, residue-class length L (common across
-    # residues), and an extended -inf pad of x out to L*s so every strided
-    # residue view has the same shape
-    P = [lo + n + hi for (lo, hi), n in zip(pads, x.shape)]
-    L = [-(-p // s) for p, s in zip(P, strides)]
-    xpad = [(lo, l * s - lo - n)
-            for (lo, _), n, s, l in zip(pads, x.shape, strides, L)]
-    xp = jnp.pad(x, xpad, constant_values=_max_init(x.dtype))
-
-    # tie count / per-window gradient weight, on the output grid
-    cnt = None
-    for off in itertools.product(*[range(d) for d in dims]):
-        e = (_maxpool_taps(xp, off, y.shape, strides) == y).astype(gy.dtype)
-        cnt = e if cnt is None else cnt + e
-    wgt = gy / cnt
-
-    parts = []
-    for r in itertools.product(*[range(s) for s in strides]):
-        # x restricted to padded positions ≡ r (mod stride): shape L
-        xr = lax.slice(xp, r,
-                       [ri + (l - 1) * s + 1
-                        for ri, l, s in zip(r, L, strides)], strides)
-        # window offsets congruent to r: o = r + j*s, j < ceil((k-r)/s);
-        # padded position r + a*s lies in window (a - j) at offset o
-        m = [max(0, -(-(k - ri) // s))
-             for k, ri, s in zip(dims, r, strides)]
-        acc = None
-        for j in itertools.product(*[range(mi) for mi in m]):
-            cfg = [(ji, li - oi - ji, 0)
-                   for ji, li, oi in zip(j, L, y.shape)]
-            yj = lax.pad(y, jnp.zeros((), y.dtype), cfg)
-            wj = lax.pad(wgt, zero, cfg)
-            t = jnp.where(xr == yj, wj, zero)
-            acc = t if acc is None else acc + t
-        parts.append(acc if acc is not None else jnp.zeros(L, gy.dtype))
-
-    if len(parts) == 1:  # all strides 1: no interleave needed
-        gxp = parts[0]
-    else:
-        d = jnp.stack(parts, axis=-1).reshape(tuple(L) + tuple(strides))
-        perm = []
-        for ax in range(nd):
-            perm += [ax, nd + ax]
-        gxp = d.transpose(perm).reshape([l * s for l, s in zip(L, strides)])
-    gx = lax.slice(gxp, [lo for lo, _ in pads],
-                   [lo + n for (lo, _), n in zip(pads, x.shape)])
-    return (gx,)
-
-
-_maxpool_tie_split.defvjp(_maxpool_fwd, _maxpool_bwd)
 
 
 def _pool_out_size(size: int, k: int, stride: int, pad: int, ceil_mode: bool) -> int:
@@ -211,7 +112,10 @@ class _PoolBase(Module):
             taps *= d
         if self.tie_split and taps <= self._TIE_SPLIT_MAX_TAPS \
                 and jnp.issubdtype(x.dtype, jnp.floating):
-            return _maxpool_tie_split(x, dims, strides, tuple(pads))
+            # ops/pool_pallas.py: exact equal-tie-split custom VJP,
+            # fused Pallas backward on supported 4-D planes
+            from bigdl_tpu.ops.pool_pallas import maxpool_tie_split
+            return maxpool_tie_split(x, dims, strides, tuple(pads))
         if not self.tie_split:
             from bigdl_tpu.ops.pooling_pallas import (
                 maxpool_argmax, pallas_pool_supported)
@@ -226,21 +130,13 @@ class _PoolBase(Module):
 
     def _avg(self, x, count_include_pad: bool, divide: bool = True):
         dims, strides, pads, declared = self._window(x)
-        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
-        if not divide:
-            return s
-        if count_include_pad:
-            # ones over data + declared padding; ceil-overflow region is zero
-            ones = jnp.ones(x.shape, x.dtype)
-            ones = jnp.pad(ones, declared, constant_values=1.0)
-            extra = [(p[0] - d[0], p[1] - d[1]) for p, d in zip(pads, declared)]
-            ones = jnp.pad(ones, extra, constant_values=0.0)
-            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
-                                       [(0, 0)] * x.ndim)
-        else:
-            ones = jnp.ones(x.shape, x.dtype)
-            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
-        return s / counts
+        # ops/pool_pallas.py: the Torch divisor map (declared padding
+        # counts, ceil-overflow never does) is a trace-time numpy
+        # constant there, the window sum a fused kernel, and the
+        # backward the exact linear transpose
+        from bigdl_tpu.ops.pool_pallas import avg_pool
+        return avg_pool(x, dims, strides, tuple(pads), tuple(declared),
+                        count_include_pad, divide)
 
 
 class SpatialMaxPooling(_PoolBase):

@@ -14,3 +14,17 @@ from bigdl_tpu.ops.attention import (  # noqa: F401
     attention_partial,
     combine_partials,
 )
+from bigdl_tpu.ops.lrn_pallas import (  # noqa: F401
+    cross_map_lrn,
+    within_channel_lrn,
+)
+from bigdl_tpu.ops.norm_pallas import (  # noqa: F401
+    contrastive_norm,
+    divisive_norm,
+    smooth2d,
+    subtractive_norm,
+)
+from bigdl_tpu.ops.pool_pallas import (  # noqa: F401
+    avg_pool,
+    maxpool_tie_split,
+)

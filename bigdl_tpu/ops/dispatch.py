@@ -1,0 +1,112 @@
+"""Kernel dispatch: one knob, per-op fallback, observable decisions.
+
+The ops library keeps TWO implementations of every fused op — a Pallas
+kernel (Mosaic-compiled on TPU, ``interpret=True`` elsewhere so the CPU
+tier-1 suite exercises the identical code path) and an XLA reference
+built from the same math.  Both sit UNDER the op's ``jax.custom_vjp``,
+so the analytically exact backward holds on either leg; this module
+decides which leg runs.
+
+Knob: ``BIGDL_KERNELS`` (read at trace time):
+
+- ``auto`` (default) — Pallas on TPU hardware when the op's support
+  predicate admits the shape/dtype; XLA everywhere else.  CPU runs keep
+  their fused-XLA paths, so enabling telemetry or running the tier-1
+  suite never silently drops onto the (slow) Pallas interpreter.
+- ``pallas`` — Pallas whenever the shape is structurally supported;
+  off-TPU this means interpret mode (the parity tests' setting).
+- ``xla`` — the reference leg everywhere, a process-wide kill switch.
+
+Every decision is emitted as a ``kernel/dispatch`` telemetry instant
+(op, backend, reason) at TRACE time — one instant per compilation, not
+per step — so PR 4's attribution can say which backend each module's
+HLO actually contains.  A small in-process ring (:func:`decisions`)
+records the same tuples for tests and the micro-bench harness.
+
+Caveat: the knob is read when a function is traced.  A jit-cached
+executable does not re-dispatch when the env changes; tests flip the
+env with fresh shapes (or eagerly) for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Callable, Deque, List, Tuple
+
+from bigdl_tpu import telemetry
+
+__all__ = ["kernel_mode", "choose_backend", "dispatch", "use_interpret",
+           "decisions", "clear_decisions", "MODES"]
+
+MODES = ("auto", "pallas", "xla")
+
+#: last N (op, backend, reason) decisions, trace-time order
+_DECISIONS: Deque[Tuple[str, str, str]] = deque(maxlen=256)
+
+
+def kernel_mode() -> str:
+    """The process-wide kernel mode from ``BIGDL_KERNELS``.
+
+    Raises on an unknown value instead of silently defaulting — a typo'd
+    sweep leg comparing ``pallas`` against ``palas`` must fail loudly,
+    not bench two identical XLA runs (same policy as
+    ``flash_min_seq``)."""
+    raw = os.environ.get("BIGDL_KERNELS", "auto")
+    if raw not in MODES:
+        raise ValueError(
+            f"BIGDL_KERNELS={raw!r} is not one of {'|'.join(MODES)}")
+    return raw
+
+
+def use_interpret() -> bool:
+    """Pallas interpret mode off-TPU (device check, not backend name —
+    the round-4 proxied-PJRT gating bug)."""
+    from bigdl_tpu.ops.attention import is_tpu_device
+
+    return not is_tpu_device()
+
+
+def choose_backend(op: str, supported: bool) -> Tuple[str, str]:
+    """(backend, reason) for one op instance; backend in {pallas, xla}."""
+    mode = kernel_mode()
+    if mode == "xla":
+        return "xla", "forced:BIGDL_KERNELS=xla"
+    if not supported:
+        return "xla", "unsupported-shape"
+    if mode == "pallas":
+        return "pallas", "forced:BIGDL_KERNELS=pallas"
+    from bigdl_tpu.ops.attention import is_tpu_device
+
+    if is_tpu_device():
+        return "pallas", "auto:tpu"
+    return "xla", "auto:off-tpu"
+
+
+def note(op: str, backend: str, reason: str) -> None:
+    """Record + emit one dispatch decision (shared by :func:`dispatch`
+    and call sites with bespoke selection logic, e.g. the argmax pool
+    and the attention auto-backend)."""
+    _DECISIONS.append((op, backend, reason))
+    telemetry.instant("kernel/dispatch", op=op, backend=backend,
+                      reason=reason)
+
+
+def dispatch(op: str, pallas_fn: Callable, xla_fn: Callable,
+             supported: bool, *args, **kwargs):
+    """Run ``pallas_fn`` or ``xla_fn`` per :func:`choose_backend`,
+    recording the decision.  Called at trace time inside the op's
+    custom-vjp forward/backward rules."""
+    backend, reason = choose_backend(op, supported)
+    note(op, backend, reason)
+    fn = pallas_fn if backend == "pallas" else xla_fn
+    return fn(*args, **kwargs)
+
+
+def decisions() -> List[Tuple[str, str, str]]:
+    """Recent (op, backend, reason) tuples — test/bench introspection."""
+    return list(_DECISIONS)
+
+
+def clear_decisions() -> None:
+    _DECISIONS.clear()

@@ -1,0 +1,57 @@
+"""Shared plumbing for the Pallas kernel modules: the ONE home for the
+VMEM budget, the Mosaic dtype set, and the per-plane launcher — so the
+support predicates in lrn_pallas/norm_pallas/pool_pallas can never
+drift apart (a budget tuned in one module but not another would route
+the same shape to different backends per op)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["VMEM_BUDGET", "TPU_DTYPES", "mosaic_dtype", "plane_call"]
+
+#: per-block VMEM budget (bytes) — conservative vs the 16 MB/core arena
+VMEM_BUDGET = 4 * 1024 * 1024
+
+#: dtypes Mosaic compiles; anything else (f64 in the numeric-grad
+#: suite) is interpret/XLA-only
+TPU_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def mosaic_dtype(dtype) -> bool:
+    return dtype in TPU_DTYPES
+
+
+def plane_call(kernel, inputs, out_shapes, b, interpret: bool,
+               bcast=()):
+    """Launcher over [B, *, *] plane stacks: grid (B,), one full
+    (padded) plane per block — spatial windows need no neighbor blocks
+    this way, at plane sizes (<= ~224x224 f32 = 200 KB) far under the
+    VMEM budget.
+
+    ``inputs``: arrays whose leading dim is B, except indices listed in
+    ``bcast`` which are shared by every block verbatim (divisor planes,
+    smoothing kernels).  ``out_shapes``: [(per-plane shape, dtype), ...]
+    — a single entry returns the bare array."""
+    from jax.experimental import pallas as pl
+
+    in_specs = []
+    for idx, a in enumerate(inputs):
+        if idx in bcast:
+            in_specs.append(
+                pl.BlockSpec(a.shape, lambda i, nd=a.ndim: (0,) * nd))
+        else:
+            in_specs.append(
+                pl.BlockSpec((1,) + a.shape[1:],
+                             lambda i, nd=a.ndim: (i,) + (0,) * (nd - 1)))
+    multi = len(out_shapes) > 1
+    out_specs = [pl.BlockSpec((1,) + s, lambda i, nd=len(s): (i,) + (0,) * nd)
+                 for s, _ in out_shapes]
+    out_shape = [jax.ShapeDtypeStruct((b,) + s, d) for s, d in out_shapes]
+    return pl.pallas_call(
+        kernel, grid=(b,), in_specs=in_specs,
+        out_specs=out_specs if multi else out_specs[0],
+        out_shape=out_shape if multi else out_shape[0],
+        interpret=interpret,
+    )(*inputs)

@@ -72,9 +72,11 @@ def pallas_pool_supported(x, dims, strides, pads) -> bool:
     """True when (x, window) fits this path: 4-D NCHW input, window on
     the trailing two axes, 16-bit float dtype, window extents within the
     low-8-bit coordinate encoding, bounded tap count."""
+    from bigdl_tpu.ops.dispatch import kernel_mode
+
     mode = os.environ.get("BIGDL_POOL_KERNEL", "auto")
-    if mode == "off":
-        return False
+    if mode == "off" or kernel_mode() == "xla":
+        return False  # BIGDL_KERNELS=xla: process-wide Pallas kill switch
     if x.ndim != 4 or x.dtype not in (jnp.bfloat16, jnp.float16):
         return False  # f32 would need a u64 pack
     if dims[0] != 1 or dims[1] != 1 or strides[0] != 1 or strides[1] != 1:
@@ -183,10 +185,12 @@ def _fwd_packed(x, dims, strides, pads):
     p_w = lax.broadcasted_iota(jnp.uint32, x.shape, 3) + lo_w
     code = ((p_h & 0xFF) ^ 0xFF) << 8 | ((p_w & 0xFF) ^ 0xFF)
     packed = mono << 16 | code
-    # -inf's pack is the minimum over real taps; init 0 stays below any
-    # real element's pack only because mono(-inf) > 0 — use the true
-    # identity: mono maps -inf to 0x0080... so init with 0 is safe for
-    # every finite/infinite input (mono >= 0, code > 0 for real taps)
+    # init-0 invariant: mono >= 0x007F for every non-NaN input (the
+    # minimum, at -inf, is 0x007F), so packed >= 0x7F0000 > 0 for every
+    # real tap and the 0 init can never win a window that contains one.
+    # A fully-padded window would decode init 0 to a NaN rather than
+    # reduce_window's -inf, but pallas_pool_supported's pads-vs-window
+    # geometry excludes that case.
     red = lax.reduce_window(packed, jnp.uint32(0), lax.max,
                             dims, strides, pads)
 
@@ -308,11 +312,16 @@ def _bwd_impl(gy, idx, x_shape, x_dtype, dims, strides, pads):
     while _bwd_est(th, bl, cpad, taps, esz) > _VMEM_BUDGET and th > 1:
         th //= 2
 
-    # row tiling: pad top by jh_max (shift halo) + bottom to a tile
-    # multiple + one extra tile so the neighbor-block spec never reads
-    # out of range; col padding: left jw_max, right to the residue grid
+    # row tiling: pad top by jh_max (shift halo) + bottom so gyp holds
+    # EXACTLY (n_tiles + 1) row blocks — the neighbor-block spec
+    # (lambda i, l: (i + 1, ...)) reads block n_tiles for the last tile,
+    # so it must exist in-array (round-5 advisor: sizing the bottom pad
+    # off lh instead of gyt's true ho rows left the neighbor block out
+    # of range when lh > ho + jh_max, silently relying on Mosaic's
+    # block-index clamping); col padding: left jw_max, right to the
+    # residue grid
     n_tiles = -(-lh // th)
-    top, bot = jh_max, n_tiles * th - lh + th
+    top, bot = jh_max, (n_tiles + 1) * th - jh_max - ho
     right = lw - wo
     gyp = jnp.pad(gyt, ((top, bot), (jw_max, right), (0, 0)))
     idxp = jnp.pad(idxt, ((top, bot), (jw_max, right), (0, 0)),

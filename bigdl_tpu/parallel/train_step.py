@@ -578,7 +578,11 @@ class TrainStep:
     def aot_scan(self, x, y, key, n: int, stacked: bool = False):
         """AOT-compile the scan-of-n-steps once; installs the executable
         for ``run_scan`` and returns its XLA cost analysis (the scan BODY
-        is counted once — multiply flops by n for totals)."""
+        is counted once — multiply flops by n for totals).  The result is
+        passed through ``normalize_cost_analysis``: some backends/JAX
+        versions hand back a one-element list instead of the dict (the
+        CPU quirk bench.py also guards), and callers get the dict
+        contract either way."""
         x, y = self._shard_batch(x, y, stacked)
         tracer = _telemetry.get()
         t0 = time.perf_counter()
@@ -601,7 +605,8 @@ class TrainStep:
                 facts.update(_tdev.memory_facts(compiled))
                 if facts:
                     tracer.emit("device_facts", facts=facts)
-        return compiled.cost_analysis()
+        from bigdl_tpu.telemetry.device import normalize_cost_analysis
+        return normalize_cost_analysis(compiled.cost_analysis())
 
     def gather_replicated(self, tree):
         """All-gather cross-process-sharded leaves to replicated (no-op on

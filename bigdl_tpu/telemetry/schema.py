@@ -67,6 +67,10 @@ STREAM_NAMES = frozenset({
     "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
     "metrics/serving", "profile/armed", "profile/captured",
     "flight/dump",
+    # kernel dispatch (bigdl_tpu/ops/dispatch.py): one instant per
+    # TRACE-time backend decision — op, backend (pallas|xla), reason —
+    # so attribution can name which backend each module compiled to
+    "kernel/dispatch",
     # fault tolerance (bigdl_tpu/faults.py + docs/fault_tolerance.md):
     # injected faults, quarantined torn checkpoints, graceful
     # preemption, and checkpoint auto-resume

@@ -34,6 +34,14 @@ PINNED_MODULES = [
     "bigdl_tpu/telemetry/schema.py",
     "bigdl_tpu/telemetry/flight.py",
     "bigdl_tpu/telemetry/metrics_http.py",
+    # the kernel library (PR 6): losing any of these silently reverts
+    # hot paths to unfused XLA chains and wrong-by-autodiff VJPs
+    "bigdl_tpu/ops/dispatch.py",
+    "bigdl_tpu/ops/lrn_pallas.py",
+    "bigdl_tpu/ops/norm_pallas.py",
+    "bigdl_tpu/ops/pool_pallas.py",
+    "bigdl_tpu/ops/pooling_pallas.py",
+    "bigdl_tpu/ops/attention.py",
 ]
 
 
