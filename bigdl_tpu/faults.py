@@ -33,6 +33,9 @@ Plan syntax — comma-separated ``kind[@step][:pP]`` specs::
 | ``nan_grads`` | TrainStep gradient path (in-graph)| health halt/skip    |
 | ``data_err``  | dataset fetch (prefetch relay)   | retry on data errors |
 | ``torn_ckpt`` | checkpoint write (post-commit)   | digest verify + quarantine |
+| ``peer_kill`` | Optimizer loop (SIGKILL self)    | collective watchdog + supervised restart |
+| ``peer_wedge``| inside the iteration (no straggler rescue needed) | peer-heartbeat deadline |
+| ``commit_crash``| cluster commit barrier (post-write, pre-ack) | manifest-capped restore (no mixed steps) |
 
 Determinism: the spec is positional (step numbers, not probabilities)
 and the only random choices (which bytes ``torn_ckpt`` flips) come from
@@ -58,12 +61,16 @@ __all__ = ["KINDS", "FaultSpec", "FaultPlan", "InjectedFault",
 
 log = logging.getLogger("bigdl_tpu.faults")
 
-#: every fault class the plan understands (docs/fault_tolerance.md)
+#: every fault class the plan understands (docs/fault_tolerance.md);
+#: the ``peer_*``/``commit_crash`` kinds are the DISTRIBUTED matrix —
+#: aimed at the cluster watchdog + commit barrier (parallel/cluster.py)
 KINDS = ("crash", "wedge", "kill_worker", "preempt", "nan_grads",
-         "data_err", "torn_ckpt")
+         "data_err", "torn_ckpt", "peer_kill", "peer_wedge",
+         "commit_crash")
 
 #: kinds polled by the Optimizer iteration loop
-_ITERATION_KINDS = ("crash", "wedge", "kill_worker", "preempt")
+_ITERATION_KINDS = ("crash", "wedge", "kill_worker", "preempt",
+                    "peer_kill", "peer_wedge")
 
 #: how long a wedged iteration sleeps — far past any sane straggler
 #: budget; only the watchdog (or the harness timeout) ends it
@@ -94,9 +101,9 @@ class FaultSpec:
             return False
         if self.step is None:
             return True
-        if self.kind == "torn_ckpt":
+        if self.kind in ("torn_ckpt", "commit_crash"):
             # checkpoints land on trigger steps only; fire on the first
-            # write at-or-after the requested step
+            # write/commit at-or-after the requested step
             return step >= self.step
         return step == self.step
 
@@ -181,9 +188,12 @@ class FaultPlan:
         self._announce(spec, step, "iteration")
         if spec.kind == "crash":
             raise InjectedFault(f"injected crash at step {step}")
-        if spec.kind == "kill_worker":
+        if spec.kind in ("kill_worker", "peer_kill"):
             # the ungraceful death: no handler runs, no checkpoint
             # commits — recovery is the NEXT process's resume path
+            # (peer_kill: the same SIGKILL aimed at the CLUSTER matrix —
+            # the surviving hosts' collective watchdog is what's under
+            # test, parallel/cluster.py)
             os.kill(os.getpid(), signal.SIGKILL)
             time.sleep(60)  # SIGKILL delivery is asynchronous
         if spec.kind == "preempt":
@@ -191,6 +201,10 @@ class FaultPlan:
             # exercised, not simulated
             os.kill(os.getpid(), signal.SIGTERM)
             return None
+        # wedge: stall under the HOST straggler guard; peer_wedge: the
+        # same stall, but the mechanism under test is the CLUSTER
+        # watchdog — with no BIGDL_ITERATION_TIMEOUT set, only the
+        # peer-heartbeat deadline (or the harness timeout) ends it
         return "wedge"
 
     def wedge_stall(self) -> None:
@@ -230,6 +244,20 @@ class FaultPlan:
                 yield batch
 
         return gen()
+
+    def poll_commit(self, step: int) -> None:
+        """Called by the cluster commit barrier AFTER this host's local
+        checkpoint write is durable and BEFORE its barrier ack lands
+        (``parallel/cluster.py``): a ``commit_crash`` fault SIGKILLs
+        this process in exactly that window — the checkpoint exists
+        locally, the cluster never certified it, and the manifest (not
+        the newest file on disk) must decide what restores."""
+        spec = self._claim(("commit_crash",), step)
+        if spec is None:
+            return
+        self._announce(spec, step, "commit")
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)  # SIGKILL delivery is asynchronous
 
     def poll_checkpoint(self, path: str, step: int) -> None:
         """Called after a checkpoint write COMMITS (meta marker on

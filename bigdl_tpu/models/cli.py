@@ -11,6 +11,9 @@ Usage::
     python -m bigdl_tpu.models.cli perf   --model inception_v1 -b 64 -i 10
     python -m bigdl_tpu.models.cli summary   --model lenet
     python -m bigdl_tpu.models.cli attribute --model transformer
+    python -m bigdl_tpu.models.cli supervise -n 4 -- \
+        python -m bigdl_tpu.models.cli train --model lenet --distributed \
+        --checkpoint ./ckpt
 
 ``train`` runs the full Optimizer loop (validation every epoch, optional
 checkpointing and TensorBoard summaries, resume from snapshot);
@@ -135,8 +138,16 @@ def cmd_train(args) -> None:
     import bigdl_tpu.nn as nn
     import bigdl_tpu.optim as optim
     from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.utils.engine import Engine
     from bigdl_tpu.utils.rng import RNG
 
+    if getattr(args, "distributed", False):
+        # join the cluster FIRST: jax.distributed.initialize must run
+        # before any jax computation, and building the model below
+        # already executes some — without this, a multi-process
+        # `train --distributed` (e.g. under `supervise`) dies at
+        # DistriOptimizer construction
+        Engine.init()
     RNG.set_seed(args.seed)
     x, y = _load_data(args.model, args.folder, "train", args.num_classes)
     xt, yt = _load_data(args.model, args.folder, "test", args.num_classes)
@@ -323,6 +334,34 @@ def cmd_perf(args) -> None:
           f"{wall:.2f}s)")
 
 
+def cmd_supervise(args) -> None:
+    """Supervised elastic cluster launch (parallel/cluster.py): run N
+    copies of a worker command as a jax.distributed cluster, let the
+    collective watchdog turn peer loss into clean aborts instead of
+    hung all-reduces, and restart the full cluster from the last
+    cluster-consistent checkpoint when an incarnation dies."""
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+    from bigdl_tpu.parallel.cluster import Supervisor
+
+    command = list(args.command or [])
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        raise SystemExit(
+            "supervise needs a worker command, e.g.:\n"
+            "  python -m bigdl_tpu.models.cli supervise -n 4 -- "
+            "python -m bigdl_tpu.models.cli train --model lenet "
+            "--distributed --checkpoint ./ckpt")
+    sup = Supervisor(nprocs=args.nprocs, command=command,
+                     max_restarts=args.max_restarts,
+                     cluster_dir=args.cluster_dir,
+                     keep_faults=args.keep_faults,
+                     log_dir=args.log_dir)
+    raise SystemExit(sup.run())
+
+
 def cmd_summary(args) -> None:
     """Torch-style per-layer table over a registry model — reuses the
     module-path machinery the cost attribution is built on."""
@@ -408,6 +447,33 @@ def main(argv=None) -> None:
     pf.add_argument("--bf16", action="store_true", default=True)
     pf.add_argument("--no-bf16", dest="bf16", action="store_false")
     pf.set_defaults(fn=cmd_perf)
+
+    sv = sub.add_parser("supervise",
+                        help="launch + babysit an N-process cluster: "
+                             "watchdog-clean peer-loss aborts, bounded "
+                             "restarts from the last cluster-consistent "
+                             "checkpoint (docs/fault_tolerance.md)")
+    sv.add_argument("-n", "--nprocs", type=int, required=True,
+                    help="cluster size (one jax process per slot)")
+    sv.add_argument("--max-restarts", type=int, default=5,
+                    help="full-cluster restarts before giving up")
+    sv.add_argument("--cluster-dir", default=None,
+                    help="shared heartbeat/commit dir (default: a fresh "
+                         "temp dir; must be shared storage on real "
+                         "multi-host fleets)")
+    sv.add_argument("--log-dir", default=None,
+                    help="capture each worker's stdout+stderr to "
+                         "<dir>/inc<k>.p<i>.log (a SIGKILLed worker "
+                         "leaves no flight dump — this is the "
+                         "supervisor-side postmortem record)")
+    sv.add_argument("--keep-faults", action="store_true",
+                    help="keep BIGDL_FAULTS for restart incarnations "
+                         "(default: cleared — an injected fault plan "
+                         "describes one scenario, not every restart)")
+    sv.add_argument("command", nargs=argparse.REMAINDER, metavar="-- cmd",
+                    help="worker command to run n times with the "
+                         "cluster env injected")
+    sv.set_defaults(fn=cmd_supervise)
 
     sm = sub.add_parser("summary", help="Torch-style per-layer table "
                                         "(shapes via eval_shape)")

@@ -19,7 +19,6 @@ from __future__ import annotations
 import atexit
 import logging
 import os
-import random
 import re
 import signal
 import threading
@@ -33,6 +32,7 @@ import numpy as np
 
 from bigdl_tpu import faults as _faults
 from bigdl_tpu import telemetry
+from bigdl_tpu.parallel import cluster as _cluster
 from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet
 from bigdl_tpu.dataset.minibatch import MiniBatch
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
@@ -463,10 +463,24 @@ class Optimizer:
             def tail():
                 if finish is not None:
                     finish()
+                svc = _cluster.get()
+                if svc is not None:
+                    # two-phase cluster commit (parallel/cluster.py):
+                    # THIS host's shards are durable — ack; the
+                    # coordinator rolls all acks into the cluster
+                    # manifest that gates restore eligibility
+                    svc.commit_step(self._ckpt_dir, n)
                 if self._ckpt_keep and Engine.is_coordinator():
+                    # the manifest step is pinned: cluster restores CAP
+                    # at it, so pruning it (because newer, possibly
+                    # uncertified checkpoints fill the keep window)
+                    # would strand the whole cluster
+                    cap = (svc.restore_cap(self._ckpt_dir)
+                           if svc is not None else None)
                     for p in sharded_ckpt.prune_old(self._ckpt_dir,
                                                     self._ckpt_keep,
-                                                    trusted=dest):
+                                                    trusted=dest,
+                                                    keep_step=cap):
                         log.info(f"[Checkpoint] pruned {p}")
                 log.info(f"[Checkpoint] saved sharded.{n} "
                          f"to {self._ckpt_dir}")
@@ -489,6 +503,12 @@ class Optimizer:
         self.optim_method.state["func_state"] = jax.tree.map(
             np.asarray, step.gather_replicated(step.opt_state))
         if not Engine.is_coordinator():
+            svc = _cluster.get()
+            if svc is not None:
+                # BTPU writes are coordinator-only, but the commit
+                # barrier still needs every host's ack: "I reached the
+                # step-n commit point with consistent driver state"
+                svc.commit_step(self._ckpt_dir, n)
             return
         # snapshot to bytes NOW (consistent state); the IO can overlap
         # with the next training iterations (BIGDL_ASYNC_CHECKPOINT)
@@ -518,6 +538,13 @@ class Optimizer:
                 _faults.get_plan().poll_checkpoint(blobs[0][1], n)
             except Exception:  # noqa: BLE001 - injection never fails a save
                 pass
+            svc = _cluster.get()
+            if svc is not None:
+                # coordinator ack + manifest roll-up: the per-host
+                # digests recorded in the meta marker travel with the
+                # ack into the cluster manifest
+                svc.commit_step(self._ckpt_dir, n,
+                                digests=meta["digests"])
             if self._ckpt_keep:
                 self._prune_btpu(trusted=n)
             log.info(f"[Checkpoint] saved model.{n} / optimMethod.{n} "
@@ -553,6 +580,12 @@ class Optimizer:
                       for f in File.listdir(d)
                       if (m := re.match(r"model\.(\d+)$", f)))
         victims = nums[:-self._ckpt_keep]
+        svc = _cluster.get()
+        if svc is not None:
+            # never prune the cluster-manifest step: cluster restores
+            # cap at it, and newer (uncertified) pairs can't replace it
+            cap = svc.restore_cap(d)
+            victims = [n for n in victims if n != cap]
         if victims and not any(n == trusted or self._btpu_verify(d, n)[0]
                                for n in
                                reversed(nums[-self._ckpt_keep:])):
@@ -598,10 +631,19 @@ class Optimizer:
         and the walk falls back to the previous good step — a restore
         either loads a byte-verified checkpoint fully or reports there
         is none (``docs/fault_tolerance.md``)."""
+        # cluster runs restore ONLY what the commit barrier certified:
+        # the manifest step caps the walk, so a checkpoint some host
+        # wrote but the cluster never acked is structurally invisible —
+        # every host lands on the same step (parallel/cluster.py)
+        svc = _cluster.get()
+        cap = svc.restore_cap(d) if svc is not None else None
+        if cap is not None:
+            log.info(f"[Recovery] cluster manifest caps restore at "
+                     f"step {cap} under {d}")
         if self._ckpt_backend == "sharded":
             from bigdl_tpu.utils.sharded_ckpt import latest_verified_step_dir
 
-            latest = latest_verified_step_dir(d)
+            latest = latest_verified_step_dir(d, max_step=cap)
             if latest is None:
                 return False
             # applied onto the fresh TrainStep inside _optimize_once (the
@@ -614,6 +656,8 @@ class Optimizer:
         nums = sorted({int(m.group(1)) for f in File.listdir(d)
                        if (m := re.match(r"model\.(\d+)$", f))},
                       reverse=True)
+        if cap is not None:
+            nums = [n for n in nums if n <= cap]
         for n in nums:
             ok, problems = self._btpu_verify(d, n)
             mfile = File.join(d, f"model.{n}")
@@ -881,16 +925,27 @@ class Optimizer:
                 f"(falsy spellings 0/false/no also read as off)")
         self._init_checkpoint_dir()
         self._telemetry_begin(cfg)
+        # cluster fault tolerance (parallel/cluster.py): peer heartbeat
+        # + collective watchdog + commit barrier, active only when
+        # BIGDL_CLUSTER_DIR is set on a multi-process run
+        _cluster.activate()
         self.preempted = False
         # graceful SIGTERM/SIGINT: finish the step, commit a final
         # checkpoint, return — the TPU-slice preemption contract
         self._preempt = _PreemptGuard().install()
         _LIVE_CKPT_WRITERS.add(self)
+        # explicit clean-exit flag for the final heartbeat status:
+        # sys.exc_info() in the finally would also see an exception a
+        # CALLER is currently handling (optimize() invoked from inside
+        # an except block) and misreport a clean run as failed
+        self._run_completed = False
         try:
             self._maybe_resume()
             while True:
                 try:
-                    return self._optimize_once()
+                    result = self._optimize_once()
+                    self._run_completed = True
+                    return result
                 except KeyboardInterrupt:
                     self._flight_dump("keyboard_interrupt")
                     raise
@@ -926,7 +981,27 @@ class Optimizer:
                                 f"retry {len(failures)}/{retry_times} "
                                 f"after {backoff:.2f}s backoff")
                     if backoff > 0:
-                        time.sleep(backoff)
+                        # wait on the preempt guard's event, not a bare
+                        # sleep: a SIGTERM landing mid-backoff must reach
+                        # the grace path NOW, not after the full sleep
+                        self._preempt.requested.wait(backoff)
+                    if self._preempt.requested.is_set():
+                        # preempted between attempts: there is no
+                        # in-flight step to finish — join any pending
+                        # write and exit clean; the last committed
+                        # checkpoint is the resume point
+                        self._join_checkpoint_write()
+                        self.preempted = True
+                        telemetry.instant(
+                            "run/preempted",
+                            step=self.state.get("neval", 0),
+                            epoch=self.state.get("epoch", 1),
+                            signum=self._preempt.signum or 0)
+                        log.warning(
+                            "[Preempt] preemption during retry backoff: "
+                            "exiting with the last committed checkpoint "
+                            "as the resume point")
+                        return self.model
                     if not self._restore_latest():
                         log.warning("no checkpoint to restore; restarting from current weights")
         finally:
@@ -936,6 +1011,13 @@ class Optimizer:
                 self._join_checkpoint_write()
             except Exception:  # noqa: BLE001 - never mask the real error
                 pass
+            # final heartbeat status AFTER the write join (the barrier
+            # ack rides the write tail): peers read done/preempted as a
+            # clean exit, failed as an immediate peer loss
+            _cluster.deactivate(
+                "preempted" if getattr(self, "preempted", False)
+                else ("done" if getattr(self, "_run_completed", False)
+                      else "failed"))
             self._telemetry_end()
 
     def _retry_backoff(self, attempt: int) -> float:
@@ -943,12 +1025,11 @@ class Optimizer:
         (``BIGDL_RETRY_BACKOFF`` base seconds, cap 30s): a persistently
         failing step must not hot-loop through the retry budget in
         milliseconds.  Jitter desynchronizes a fleet of workers retrying
-        the same shared-storage restore."""
-        base = get_config().retry_backoff
-        if base <= 0:
-            return 0.0
-        return min(30.0, base * (2.0 ** max(attempt - 1, 0))) \
-            * random.uniform(0.5, 1.0)
+        the same shared-storage restore.  One shared policy with the
+        cluster Supervisor (``utils.config.retry_backoff_s``)."""
+        from bigdl_tpu.utils.config import retry_backoff_s
+
+        return retry_backoff_s(attempt)
 
     def _flight_dump(self, reason: str, evidence: Optional[Dict] = None):
         """Dump the flight recorder (telemetry/flight.py) on the way out
@@ -1068,8 +1149,14 @@ class Optimizer:
                  f"(sync={self.parameter_sync}, compression={self.gradient_compression})")
         tele = telemetry.get()
         tele_base = tele.depth() if tele else 0
+        cluster_svc = _cluster.get()
         try:
             while not self.end_when(self.state):
+                # peer heartbeat FIRST (parallel/cluster.py): a fault
+                # killing this process mid-iteration must leave the
+                # step-started beat behind for the peers' watchdogs
+                if cluster_svc is not None:
+                    cluster_svc.beat(self.state["neval"] + 1)
                 # fault plan, iteration point: crash raises into the
                 # retry loop, kill_worker/preempt signal this process,
                 # wedge stalls INSIDE the straggler-guarded region below
@@ -1144,6 +1231,11 @@ class Optimizer:
                 n = batch_n * record_scale  # global records this iteration
                 self.state["neval"] += 1
                 self.state["loss"] = loss
+                if cluster_svc is not None:
+                    # step COMPLETED: refresh the heartbeat and arm the
+                    # watchdog (the first completed step ends the
+                    # compile exemption)
+                    cluster_svc.beat(self.state["neval"], done=True)
                 records_this_epoch += n
                 self.state["records"] = records_this_epoch
                 self.metrics.add("data time", t_data - t_start)
@@ -1205,12 +1297,20 @@ class Optimizer:
                             telemetry.span("validation"):
                         step.sync_to_model()
                         self._validate(eval_step)
+                    if cluster_svc is not None:
+                        # beat BETWEEN validation and checkpoint: the
+                        # silent window peers must tolerate is one
+                        # activity, never the two summed
+                        cluster_svc.beat(self.state["neval"], done=True)
                 ckpt_fired = self._ckpt_trigger is not None \
                     and self._ckpt_trigger(self.state)
                 if ckpt_fired:
                     with self.metrics.timer("checkpoint time"), \
                             telemetry.span("checkpoint"):
                         self._save_checkpoint(step)
+                if cluster_svc is not None:
+                    # refresh after the (possibly slow) checkpoint too
+                    cluster_svc.beat(self.state["neval"], done=True)
                 preempt = getattr(self, "_preempt", None)
                 if preempt is not None and preempt.requested.is_set():
                     # graceful preemption: the in-flight step finished

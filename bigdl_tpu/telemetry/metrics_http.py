@@ -19,7 +19,10 @@ counts).  A stdlib ``ThreadingHTTPServer`` on a daemon thread serves it:
   optimizer loop starts/stops the trace, training never blocks.  409
   when a capture is already armed or running; optional ``dir=<path>``
   overrides the trace directory;
-- ``GET /healthz``  — liveness (always 200 while the run is alive).
+- ``GET /healthz``  — liveness: 200 while the run is alive, **503 when
+  the cluster watchdog presumes a peer lost** (``parallel/cluster.py``;
+  ``/status`` then carries ``cluster: {state: degraded, peers: ...}``
+  with the per-peer heartbeat table).
 
 Enabled by ``BIGDL_METRICS_PORT`` (or ``--metrics-port`` on
 ``models/cli.py``); port ``0`` binds an ephemeral port, logged at run
@@ -219,8 +222,8 @@ class MetricsSink:
 
 
 def _observer_status() -> Dict[str, Any]:
-    """Profiler + flight-recorder state for /status (process-wide
-    singletons, not per-sink state)."""
+    """Profiler + flight-recorder + cluster state for /status
+    (process-wide singletons, not per-sink state)."""
     out: Dict[str, Any] = {}
     try:
         from bigdl_tpu.telemetry import profiler
@@ -235,7 +238,23 @@ def _observer_status() -> Dict[str, Any]:
         out["flight"] = fr.status() if fr is not None else None
     except Exception:  # noqa: BLE001
         pass
+    try:
+        cl = _cluster_service()
+        if cl is not None:
+            # the per-peer heartbeat table (step, age, status, lost
+            # reason) — docs/fault_tolerance.md "Distributed failures"
+            out["cluster"] = cl.status()
+    except Exception:  # noqa: BLE001
+        pass
     return out
+
+
+def _cluster_service():
+    """The active cluster fault-tolerance service
+    (``parallel/cluster.py``), or None outside cluster runs."""
+    from bigdl_tpu.parallel import cluster
+
+    return cluster.get()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -254,6 +273,21 @@ class _Handler(BaseHTTPRequestHandler):
                         ).encode("utf-8")
                 ctype = "application/json"
             elif path == "/healthz":
+                # liveness turns 503 when any peer is presumed lost —
+                # an external prober (or the supervisor's cluster
+                # manager analogue) reads "this process is about to
+                # abort the dead collective" without parsing /status
+                degraded = False
+                try:
+                    cl = _cluster_service()
+                    degraded = cl is not None and cl.degraded()
+                except Exception:  # noqa: BLE001 - liveness stays up
+                    pass
+                if degraded:
+                    self._respond(
+                        503, b'{"ok": false, "cluster": "degraded"}\n',
+                        "application/json")
+                    return
                 body = b'{"ok": true}\n'
                 ctype = "application/json"
             else:

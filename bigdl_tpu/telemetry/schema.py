@@ -76,6 +76,11 @@ STREAM_NAMES = frozenset({
     # preemption, and checkpoint auto-resume
     "fault/injected", "checkpoint/quarantined", "run/preempted",
     "run/resumed",
+    # cluster fault tolerance (bigdl_tpu/parallel/cluster.py): peer
+    # declared lost by the collective watchdog, a checkpoint step
+    # certified cluster-consistent by the commit barrier, and a
+    # supervised full-cluster restart
+    "cluster/peer_lost", "cluster/commit", "cluster/restart",
     # health findings (telemetry/health.py detectors + policy)
     "health/nonfinite", "health/skip", "health/loss_spike",
     "health/plateau", "health/grad_explosion", "health/halt",

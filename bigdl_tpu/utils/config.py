@@ -43,6 +43,9 @@ test (or an embedding application) can inject overrides with
 | resume                 | BIGDL_RESUME                | auto-resume from the checkpoint dir: auto / off (docs/fault_tolerance.md) |
 | faults                 | BIGDL_FAULTS                | deterministic fault-injection plan (bigdl_tpu/faults.py) |
 | faults_seed            | BIGDL_FAULTS_SEED           | seed for the plan's random choices (torn bytes) |
+| cluster_dir            | BIGDL_CLUSTER_DIR           | shared dir for peer heartbeats + commit barrier (parallel/cluster.py; unset = cluster fault tolerance off) |
+| cluster_deadline       | BIGDL_CLUSTER_DEADLINE      | peer-heartbeat deadline seconds (0 = derive from the straggler budget, else 120s) |
+| heartbeat_interval     | BIGDL_HEARTBEAT_INTERVAL    | heartbeat publish/poll throttle seconds (default 1.0) |
 
 Performance knobs read directly at their consumer (hardware-tuning
 surface, not part of the typed object because they are read at trace
@@ -55,6 +58,7 @@ time inside jitted-program construction):
 | BIGDL_POOL_KERNEL     | ops.pooling_pallas argmax-index pool (off/auto/on/interpret; auto=off — see BASELINE.md postmortem) |
 | BIGDL_COMPILE_CACHE   | Engine.enable_compile_cache persistent XLA executable cache dir |
 | BIGDL_SINGLETON_WAIT  | Engine.check_singleton bounded wait (s) for a lock holder |
+| BIGDL_COORDINATOR_TIMEOUT | Engine._init_distributed bounded jax.distributed join (s, default 300; 0 = unbounded) |
 | BIGDL_PEAK_FLOPS      | telemetry.device MFU denominator override (FLOP/s per device) |
 | JAX_PLATFORMS         | honored over externally-registered PJRT plugins via honor_platform_request |
 """
@@ -65,7 +69,23 @@ import os
 from dataclasses import dataclass, field, fields
 from typing import Optional
 
-__all__ = ["BigDLConfig", "get_config", "set_config"]
+__all__ = ["BigDLConfig", "get_config", "set_config", "retry_backoff_s"]
+
+
+def retry_backoff_s(attempt: int, base: Optional[float] = None) -> float:
+    """The ONE restart/retry backoff policy: exponential from ``base``
+    seconds (default: the ``BIGDL_RETRY_BACKOFF`` config) with
+    multiplicative jitter, capped at 30 s; ``base <= 0`` disables.
+    Shared by the Optimizer retry loop and the cluster Supervisor so
+    the two cannot drift apart."""
+    import random
+
+    if base is None:
+        base = get_config().retry_backoff
+    if base <= 0:
+        return 0.0
+    return min(30.0, base * (2.0 ** max(attempt - 1, 0))) \
+        * random.uniform(0.5, 1.0)
 
 
 def _truthy(v: Optional[str]) -> bool:
@@ -125,6 +145,12 @@ class BigDLConfig:
     # deterministic fault injection (bigdl_tpu/faults.py); "" = none
     faults: str = ""
     faults_seed: int = 0
+    # cluster fault tolerance (bigdl_tpu/parallel/cluster.py): shared
+    # heartbeat/commit dir (None = off), peer deadline (0 = derived),
+    # heartbeat write/poll throttle
+    cluster_dir: Optional[str] = None
+    cluster_deadline: float = 0.0
+    heartbeat_interval: float = 1.0
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -177,6 +203,9 @@ class BigDLConfig:
             resume=(env.get("BIGDL_RESUME") or "auto").strip().lower(),
             faults=(env.get("BIGDL_FAULTS") or "").strip(),
             faults_seed=_int("BIGDL_FAULTS_SEED", 0),
+            cluster_dir=env.get("BIGDL_CLUSTER_DIR") or None,
+            cluster_deadline=_float("BIGDL_CLUSTER_DEADLINE", 0.0),
+            heartbeat_interval=_float("BIGDL_HEARTBEAT_INTERVAL", 1.0),
         )
 
 

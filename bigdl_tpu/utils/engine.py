@@ -126,10 +126,31 @@ class _Engine:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # noqa: BLE001 - older jax: knob missing
             pass
+        # bounded join: under the cluster supervisor
+        # (parallel/cluster.py) a restart incarnation re-dials a FRESH
+        # coordinator — if the coordinator slot died before serving, an
+        # unbounded initialize would hang this incarnation forever and
+        # eat the supervisor's restart budget as a silent stall
+        kwargs = {}
+        timeout = int(float(os.environ.get("BIGDL_COORDINATOR_TIMEOUT",
+                                           "300")))
+        if timeout > 0:
+            # feature-detect BEFORE calling: a TypeError from inside
+            # initialize leaves jax's global state half-set and a
+            # retry then dies on "should only be called once"
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    jax.distributed.initialize).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if "initialization_timeout" in params:
+                kwargs["initialization_timeout"] = timeout
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,
-            process_id=cfg.process_id)
+            process_id=cfg.process_id, **kwargs)
         self._distributed = True
 
     # -- init ---------------------------------------------------------------

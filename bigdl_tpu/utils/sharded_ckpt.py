@@ -267,12 +267,21 @@ def latest_step_dir(root: str, prefix: str = "sharded") -> Optional[str]:
 
 
 def latest_verified_step_dir(root: str, prefix: str = "sharded",
-                             do_quarantine: bool = True) -> Optional[str]:
+                             do_quarantine: bool = True,
+                             max_step: Optional[int] = None
+                             ) -> Optional[str]:
     """Newest complete checkpoint that also passes digest verification.
     Candidates that fail are quarantined (``*.corrupt``) on the way down
     so discovery converges — the caller gets the newest GOOD step or
-    None, never a torn one."""
+    None, never a torn one.
+
+    ``max_step`` is the cluster-consistent variant
+    (``parallel/cluster.py``): steps ABOVE the cap are skipped without
+    quarantine — they are intact, merely never certified by the
+    cluster commit barrier, so a cluster restore must not see them."""
     for _n, p in sorted(_numbered(root, prefix), reverse=True):
+        if max_step is not None and _n > max_step:
+            continue
         ok, problems = verify_step_dir(p)
         if ok:
             return p
@@ -285,7 +294,8 @@ def latest_verified_step_dir(root: str, prefix: str = "sharded",
 
 
 def prune_old(root: str, keep: int, prefix: str = "sharded",
-              trusted: Optional[str] = None) -> List[str]:
+              trusted: Optional[str] = None,
+              keep_step: Optional[int] = None) -> List[str]:
     """Delete all but the newest ``keep`` complete checkpoints under
     ``root``; returns the pruned paths.  Retention policy the reference
     lacks (its ``model.n`` files accumulate forever) but pod-scale
@@ -296,11 +306,16 @@ def prune_old(root: str, keep: int, prefix: str = "sharded",
     torn, it is the only state a restore can still fall back to.
     ``trusted`` names a checkpoint the caller certifies as good (the
     one it JUST wrote and digested) so the retention guard need not
-    re-read and re-hash it on every save."""
+    re-read and re-hash it on every save.
+
+    ``keep_step`` additionally pins one step number (the cluster
+    manifest's — ``parallel/cluster.py``): cluster restores are CAPPED
+    at that step, so deleting it would strand the cluster even though
+    newer (uncertified) checkpoints exist on disk."""
     if keep < 1:
         raise ValueError("keep must be >= 1")
     done = sorted(_numbered(root, prefix))
-    victims = done[:-keep]
+    victims = [v for v in done[:-keep] if v[0] != keep_step]
     if victims:
         # the newest survivor that verifies makes every victim safe to
         # drop (trusted short-circuit, then newest-first early exit);

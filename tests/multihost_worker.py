@@ -97,7 +97,11 @@ def main():
         every = int(os.environ.get("BIGDL_TEST_CKPT_EVERY", "0"))
         trigger = optim.Trigger.several_iteration(every) if every \
             else optim.Trigger.every_epoch()
-        o.set_checkpoint(ckpt, trigger)
+        # backend "sharded" = per-host writes (the layout where the
+        # cluster commit barrier earns its keep, tests/test_cluster.py)
+        o.set_checkpoint(ckpt, trigger,
+                         backend=os.environ.get("BIGDL_TEST_CKPT_BACKEND",
+                                                "btpu"))
         o.overwrite_checkpoint()
     trained = o.optimize()
 

@@ -92,12 +92,14 @@ def _assert_same_params(path_a: str, path_b: str):
                                    err_msg=f"param {k} diverged")
 
 
+@pytest.mark.deadline(240)
 def test_two_process_training_matches_single_process(tmp_path):
     mp = _run_cluster(tmp_path, "mp")
     sp = _run_single(tmp_path, "sp")
     _assert_same_params(mp, sp)
 
 
+@pytest.mark.deadline(300)
 def test_four_process_training_matches_single_process(tmp_path):
     """Scale the control-plane test to 4 processes (4 x 2 virtual devices
     = an 8-device global mesh): the trajectory must still match the
@@ -108,6 +110,7 @@ def test_four_process_training_matches_single_process(tmp_path):
     _assert_same_params(mp, sp)
 
 
+@pytest.mark.deadline(240)
 def test_two_process_zero1_matches_single_process(tmp_path):
     """ZeRO-1 optimizer-state sharding across the process boundary."""
     mp = _run_cluster(tmp_path, "mp_z1", BIGDL_TEST_ZERO1=1)
@@ -115,6 +118,7 @@ def test_two_process_zero1_matches_single_process(tmp_path):
     _assert_same_params(mp, sp)
 
 
+@pytest.mark.deadline(240)
 def test_two_process_fsdp_matches_single_process(tmp_path):
     """ZeRO-3: the PARAMETERS shard across the process boundary — no
     process holds a whole replica — and the trajectory still equals the
@@ -124,6 +128,7 @@ def test_two_process_fsdp_matches_single_process(tmp_path):
     _assert_same_params(mp, sp)
 
 
+@pytest.mark.deadline(240)
 def test_two_process_checkpoint_single_writer(tmp_path):
     """Checkpointing on a cluster: every process participates in the
     gathers but only the coordinator writes files."""
@@ -135,6 +140,7 @@ def test_two_process_checkpoint_single_writer(tmp_path):
     assert any(f.startswith("optimMethod.") for f in files), files
 
 
+@pytest.mark.deadline(240)
 def test_two_process_batch_feed_non_dp_layouts(tmp_path):
     """shard_local_batch must scale the global batch by how far the DATA
     axis spans processes, not by the raw process count (a multi-host
@@ -163,6 +169,7 @@ def test_engine_single_process_defaults():
     assert len(Engine.local_devices()) == Engine.device_count()
 
 
+@pytest.mark.deadline(600)
 def test_two_process_preempt_resume_matches_uninterrupted(tmp_path):
     """The ISSUE 5 acceptance path: SIGTERM mid-run on the 2-process
     cluster, restart the cluster, and the resumed run's final params
@@ -199,6 +206,7 @@ def test_two_process_preempt_resume_matches_uninterrupted(tmp_path):
     _assert_same_params(resumed, un)
 
 
+@pytest.mark.deadline(300)
 def test_two_process_sharded_validation_matches_full(tmp_path):
     """Validation shards round-robin over processes and merges
     collectively (optim/DistriValidator.scala:35 re-scope): the cluster's

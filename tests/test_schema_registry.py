@@ -20,7 +20,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: (tools/lint_graft.py PINNED_MODULES) — a rename/removal must fail
 #: tests, not silently drop the subsystem from the lexical scan
 PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
-          "bigdl_tpu/utils/sharded_ckpt.py"]
+          "bigdl_tpu/utils/sharded_ckpt.py",
+          "bigdl_tpu/parallel/cluster.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

@@ -31,6 +31,10 @@ PINNED_MODULES = [
     "bigdl_tpu/faults.py",
     "bigdl_tpu/utils/ckpt_digest.py",
     "bigdl_tpu/utils/sharded_ckpt.py",
+    # cluster fault tolerance (ISSUE 7): losing this silently reverts
+    # peer loss to an indefinite collective hang and restores to
+    # per-host (possibly mixed-step) discovery
+    "bigdl_tpu/parallel/cluster.py",
     "bigdl_tpu/telemetry/schema.py",
     "bigdl_tpu/telemetry/flight.py",
     "bigdl_tpu/telemetry/metrics_http.py",

@@ -72,6 +72,26 @@ if st.get("quarantined_checkpoints"):
     line += f" quarantined={st['quarantined_checkpoints']}"
 if st.get("preempted"):
     line += " PREEMPTED"
+# cluster fault tolerance (parallel/cluster.py): the per-peer heartbeat
+# table — a babysitter sees which host stalled BEFORE the watchdog
+# aborts the collective, and DEGRADED the instant a peer is presumed
+# lost (the same signal /healthz turns 503 on)
+cl = st.get("cluster") or {}
+if cl:
+    if cl.get("state") == "degraded":
+        line += " cluster=DEGRADED"
+    peers = cl.get("peers") or {}
+    cells = []
+    for name in sorted(peers):
+        p = peers[name]
+        cell = f"{name}:s{p.get('step', '?')}@{p.get('age_s', '?')}s"
+        if p.get("lost"):
+            cell += "!LOST"
+        elif p.get("status") not in ("running", None):
+            cell += f":{p['status']}"
+        cells.append(cell)
+    if cells:
+        line += " peers=" + ",".join(cells)
 print(line)
 PY
 }
