@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Open-loop serving load harness — the diff-gateable check on the
+serving layer (docs/serving.md; ROADMAP item 2).
+
+Starts a :class:`bigdl_tpu.serving.ModelServer` in-process on an
+ephemeral port, AOT-warms every bucket, then drives it with an
+**open-loop** arrival schedule: request send times are fixed up front
+at the offered rate (``--qps``), independent of completions — the load
+a server actually faces, where a slow dispatch makes the queue grow
+instead of politely slowing the clients down (closed-loop harnesses
+hide exactly the p99 failures this one exists to catch).
+
+Request sizes cycle through ``--mix`` (rows per request), so the
+steady-state traffic exercises MIXED bucket selection; the retrace
+detector is armed for the whole timed window and any in-request-path
+compile after warmup is counted separately (``steady_compiles``).
+
+Emits one ``bench.py``-style JSON line with a per-config row::
+
+    {"metric": "serving_lenet_qps", "value": 118.3, "unit": "qps",
+     "configs": {"serve_lenet": {"qps": ..., "p50_ms": ..., "p99_ms":
+     ..., "rejected": 0, "steady_compiles": 0,
+     "retrace_diagnostics": 0, ...}}}
+
+which ``python -m bigdl_tpu.telemetry diff A B`` and
+``--diff-against BASELINE.json`` (exit 4 on regression, the bench.py
+contract) compare: p50/p99 regress up, qps regresses down, and
+``steady_compiles``/``retrace_diagnostics``/``rejected`` are
+zero-slack counters — ONE production recompile fails the gate.
+
+Usage::
+
+    python bench_serving.py --model lenet --qps 100 --duration 10
+    python bench_serving.py --model lenet --diff-against BENCH_serving.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+__all__ = ["run_load", "main"]
+
+
+def _synth_rows(spec, rng, rows: int, seq_len=None) -> np.ndarray:
+    """Synthetic request payload: ``rows`` samples at the model's
+    canonical feature shape (optionally a shorter seq for token
+    models — the mixed-size part of the protocol)."""
+    shape = (rows,) + tuple(spec.shape[1:])
+    if seq_len is not None and len(shape) >= 2:
+        shape = (rows, seq_len) + tuple(shape[2:])
+    dt = np.dtype(spec.dtype)
+    if np.issubdtype(dt, np.integer):
+        return rng.integers(1, 200, shape).astype(dt)
+    return rng.normal(size=shape).astype(dt)
+
+
+def run_load(server, spec, qps: float, duration_s: float, mix,
+             seq_mix=None, senders: int = 8, timeout_s: float = 30.0):
+    """Drive ``server`` open-loop; returns client-side stats.
+
+    ``mix`` cycles request row counts; ``seq_mix`` (token models)
+    cycles sequence lengths.  Arrival times are scheduled before the
+    first send and never adjusted — a stalled server meets the full
+    backlog, exactly like production."""
+    n = max(1, int(qps * duration_s))
+    rng = np.random.default_rng(0)
+    url = f"http://127.0.0.1:{server.port}/v1/predict"
+    plan = []
+    for i in range(n):
+        rows = mix[i % len(mix)]
+        seq = seq_mix[i % len(seq_mix)] if seq_mix else None
+        body = json.dumps(
+            {"inputs": _synth_rows(spec, rng, rows, seq).tolist()}
+        ).encode("utf-8")
+        plan.append((i / qps, rows, body))
+    lat_ms, codes = [], []
+    lock = threading.Lock()
+    idx = [0]
+    start = time.perf_counter()
+
+    def sender():
+        while True:
+            with lock:
+                if idx[0] >= len(plan):
+                    return
+                at, rows, body = plan[idx[0]]
+                idx[0] += 1
+            delay = start + at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:  # noqa: BLE001 - connection-level failure
+                code = -1
+            with lock:
+                codes.append(code)
+                if code == 200:
+                    lat_ms.append((time.perf_counter() - t0) * 1000.0)
+
+    threads = [threading.Thread(target=sender, daemon=True)
+               for _ in range(senders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 3 * timeout_s)
+    wall = time.perf_counter() - start
+    lat = sorted(lat_ms)
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1,
+                             int(round(p / 100 * (len(lat) - 1))))], 3) \
+            if lat else None
+
+    return {"offered_qps": round(qps, 2),
+            "qps": round(len(lat) / wall, 2) if wall > 0 else None,
+            "requests": len(codes), "ok": len(lat),
+            "rejected": sum(1 for c in codes if c == 429),
+            "failed": sum(1 for c in codes if c not in (200, 429)),
+            "p50_ms": pct(50), "p99_ms": pct(99), "wall_s": round(wall, 3)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="lenet")
+    ap.add_argument("--num-classes", type=int, default=0)
+    ap.add_argument("--qps", type=float, default=50.0,
+                    help="offered (open-loop) request rate")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="timed window seconds")
+    ap.add_argument("--mix", default="1,1,2,4", metavar="R,R,...",
+                    help="request row-count cycle (mixed sizes "
+                         "exercise bucket selection)")
+    ap.add_argument("--seq-mix", default=None, metavar="T,T,...",
+                    help="token models: request sequence-length cycle")
+    ap.add_argument("-b", "--max-batch", type=int, default=16)
+    ap.add_argument("--buckets", default=None, metavar="N,N,...")
+    ap.add_argument("--seq-buckets", default=None, metavar="T,T,...")
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--queue-limit", type=int, default=256)
+    ap.add_argument("--senders", type=int, default=8)
+    ap.add_argument("--int8", action="store_true",
+                    help="serve quantized with calibrated static "
+                         "activation scales")
+    ap.add_argument("--diff-against", default=None,
+                    metavar="BASELINE.json",
+                    help="compare against a prior bench_serving JSON "
+                         "(telemetry diff); exit 4 on regression")
+    ap.add_argument("--diff-threshold-pct", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu import telemetry
+    from bigdl_tpu.analysis.retrace import trace_retraces
+    from bigdl_tpu.models import registry
+    from bigdl_tpu.serving import serve_model
+
+    model = registry.build_model(args.model, args.num_classes)
+    spec = registry.input_spec(args.model, 1)
+    if args.int8:
+        from bigdl_tpu.nn.quantized import calibrate, quantize
+
+        model = quantize(model)
+        calibrate(model, [_synth_rows(spec, np.random.default_rng(1),
+                                      max(2, args.max_batch // 2))])
+
+    def buckets(text):
+        return [int(b) for b in text.split(",")] if text else None
+
+    with telemetry.maybe_run(meta={"cmd": "bench_serving",
+                                   "model": args.model}) as owned_log:
+        server = serve_model(
+            model, spec, name=args.model, host="127.0.0.1", port=0,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            queue_limit=args.queue_limit,
+            batch_buckets=buckets(args.buckets),
+            seq_buckets=buckets(args.seq_buckets))
+        print(f"# serving {args.model} on :{server.port}, "
+              f"{server.executor.compile_count} buckets warm "
+              f"({server.executor.warmup_s:.1f}s)",
+              file=sys.stderr, flush=True)
+        warm_compiles = server.executor.compile_count
+        mix = [int(r) for r in args.mix.split(",")]
+        seq_mix = [int(t) for t in args.seq_mix.split(",")] \
+            if args.seq_mix else None
+        try:
+            with telemetry.span("serve/load", qps=args.qps,
+                                duration=args.duration):
+                with trace_retraces() as mon:
+                    stats = run_load(server, spec, args.qps,
+                                     args.duration, mix,
+                                     seq_mix=seq_mix,
+                                     senders=args.senders)
+            steady = server.executor.compile_count - warm_compiles
+            row = dict(stats)
+            row.update(
+                steady_compiles=steady,
+                retrace_diagnostics=len(mon.report.diagnostics),
+                warm_buckets=len(server.executor.warm_buckets()),
+                warmup_s=round(server.executor.warmup_s, 3),
+                max_batch=args.max_batch,
+                max_wait_ms=args.max_wait_ms, int8=bool(args.int8),
+                server=server.status())
+        finally:
+            server.stop(drain=True)
+    if owned_log:
+        print(f"# telemetry run log: {owned_log}", file=sys.stderr)
+
+    name = f"serve_{args.model}"
+    line = {"metric": f"serving_{args.model}_qps",
+            "value": row.get("qps"), "unit": "qps",
+            "vs_baseline": None, "configs": {name: row}}
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+    if args.diff_against:
+        from bigdl_tpu.telemetry import diff as tdiff
+
+        base = tdiff.load_metrics(args.diff_against)
+        cur = tdiff.bench_metrics(line, path="<this run>")
+        kwargs = {}
+        if args.diff_threshold_pct is not None:
+            kwargs["threshold_pct"] = args.diff_threshold_pct
+        rows = tdiff.diff_metrics(base, cur, **kwargs)
+        print(tdiff.format_diff(rows, base, cur), file=sys.stderr)
+        if not rows:
+            print("error: --diff-against found nothing comparable",
+                  file=sys.stderr)
+            return 2
+        if any(r["regressed"] for r in rows):
+            return 4  # the sweep ran; it's just slower — bench.py's code
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ Usage::
     python -m bigdl_tpu.models.cli test   --model lenet  -f ./mnist \
         --checkpoint ./ckpt
     python -m bigdl_tpu.models.cli perf   --model inception_v1 -b 64 -i 10
+    python -m bigdl_tpu.models.cli serve  --model lenet --port 8000 -b 32
     python -m bigdl_tpu.models.cli summary   --model lenet
     python -m bigdl_tpu.models.cli attribute --model transformer
     python -m bigdl_tpu.models.cli supervise -n 4 -- \
@@ -334,6 +335,67 @@ def cmd_perf(args) -> None:
           f"{wall:.2f}s)")
 
 
+def cmd_serve(args) -> None:
+    """Production inference serving (docs/serving.md): HTTP frontend ->
+    bounded queue -> continuous batcher -> bucketed AOT executables,
+    warmed before the ready line prints.  SIGTERM drains gracefully."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu import telemetry
+    from bigdl_tpu.models import registry
+    from bigdl_tpu.serving import serve_model
+    from bigdl_tpu.utils.rng import RNG
+
+    RNG.set_seed(args.seed)  # fresh-registry weights reproducible
+    if args.model_snapshot:
+        from bigdl_tpu.utils import serializer
+
+        model = serializer.load_module(args.model_snapshot)
+    else:
+        model = _build_model(args.model, args.num_classes)
+    spec = registry.input_spec(args.model, 1)
+    if args.int8:
+        from bigdl_tpu.nn.quantized import calibrate, quantize
+
+        model = quantize(model)
+        # calibrated static activation scales: the serve path must
+        # never pay the dynamic per-layer amax reduce (BASELINE.md
+        # round-6) — one synthetic batch at the canonical input spec
+        rng = np.random.default_rng(0)
+        shape = (min(8, args.batch_size),) + tuple(spec.shape[1:])
+        if np.issubdtype(np.dtype(spec.dtype), np.integer):
+            calib = rng.integers(0, 256, shape).astype(spec.dtype)
+        else:
+            calib = rng.normal(size=shape).astype(spec.dtype)
+        calibrate(model, [calib])
+
+    def _buckets(text):
+        return [int(b) for b in text.split(",")] if text else None
+
+    with telemetry.maybe_run(meta={"cmd": "serve", "model": args.model,
+                                   "batch": args.batch_size}):
+        server = serve_model(
+            model, spec, name=args.model, port=args.port,
+            max_batch=args.batch_size, max_wait_ms=args.max_wait_ms,
+            queue_limit=args.queue_limit,
+            batch_buckets=_buckets(args.buckets),
+            seq_buckets=_buckets(args.seq_buckets),
+            compute_dtype=jnp.bfloat16 if args.bf16 and not args.int8
+            else None,
+            request_timeout_s=args.request_timeout)
+        # readiness line AFTER warmup: every bucket is compiled once
+        # this prints — tests and load balancers key off it
+        print(f"serving {args.model} on port {server.port} "
+              f"(buckets {list(server.executor.policy.batch_buckets)}, "
+              f"warmup {server.executor.warmup_s:.1f}s)", flush=True)
+        server.install_signal_handlers()
+        server.wait()
+        server.stop(drain=True)
+        st = server.batcher
+        print(f"drained: {st.requests} requests, {st.rejected} rejected, "
+              f"{st.batches} batches", flush=True)
+
+
 def cmd_supervise(args) -> None:
     """Supervised elastic cluster launch (parallel/cluster.py): run N
     copies of a worker command as a jax.distributed cluster, let the
@@ -447,6 +509,41 @@ def main(argv=None) -> None:
     pf.add_argument("--bf16", action="store_true", default=True)
     pf.add_argument("--no-bf16", dest="bf16", action="store_false")
     pf.set_defaults(fn=cmd_perf)
+
+    se = sub.add_parser("serve", help="serve a zoo model over HTTP: "
+                                      "continuous batching, shape "
+                                      "buckets, AOT-warmed executables "
+                                      "(docs/serving.md)")
+    common(se)
+    se.add_argument("--port", type=int, default=8000,
+                    help="HTTP port (0 = ephemeral, printed on the "
+                         "ready line)")
+    se.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="batcher coalescing deadline from the oldest "
+                         "queued request (default %(default)s)")
+    se.add_argument("--queue-limit", type=int, default=256,
+                    help="bounded request queue; past it requests get "
+                         "429 (default %(default)s)")
+    se.add_argument("--buckets", default=None, metavar="N,N,...",
+                    help="batch buckets (default: powers of two up to "
+                         "--batch-size)")
+    se.add_argument("--seq-buckets", default=None, metavar="T,T,...",
+                    help="sequence buckets for token models (default: "
+                         "the model's fixed sequence length)")
+    se.add_argument("--int8", action="store_true",
+                    help="serve the quantized model with calibrated "
+                         "static activation scales")
+    se.add_argument("--bf16", action="store_true",
+                    help="bf16 forward with f32 params (ignored with "
+                         "--int8)")
+    se.add_argument("--request-timeout", type=float, default=30.0,
+                    help="per-request dispatch timeout seconds")
+    se.add_argument("--model-snapshot", default=None,
+                    help="serve a .btpu snapshot instead of fresh "
+                         "registry weights")
+    se.add_argument("--seed", type=int, default=42,
+                    help="weight-init seed for fresh registry weights")
+    se.set_defaults(fn=cmd_serve)
 
     sv = sub.add_parser("supervise",
                         help="launch + babysit an N-process cluster: "

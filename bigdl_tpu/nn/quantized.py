@@ -11,11 +11,15 @@ TPU-native:
   quantized inference is a throughput feature, not just a memory one.
   MEASURED (round 5, TPU v5e, BASELINE.md int8 table): VGG-16 inference
   2.09x bf16 end-to-end — the 2x MXU claim holds when the model is
-  MXU-bound.  Inception-v1 is 0.62x (a LOSS): its small-channel
-  branches are fragmentation/memory-bound, and the dynamic activation
-  quantize/dequantize passes add HBM traffic the idle int8 rate cannot
-  buy back.  Guidance: quantize big-GEMM models (VGG, transformer
-  projections); keep fragmented convnets in bf16;
+  MXU-bound.  Inception-v1 measured 0.62x (a LOSS) with DYNAMIC
+  activation scales: the per-conv global amax reduce was a full extra
+  activation read and a fusion barrier (round-6 attribution hunt,
+  BASELINE.md).  FIXED by the calibration pass: ``calibrate(model,
+  batches)`` turns each module's activation scale into a trace
+  constant, the reduce disappears, and calibrated int8 inception moves
+  0.89x the bytes of bf16 at equal flops (docs/serving.md).  Guidance:
+  calibrate before serving int8 — uncalibrated modules fall back to
+  the dynamic path;
 - weights store as int8 buffers (4x smaller than f32 in BTPU
   checkpoints and in HBM);
 - `quantize(model)` mirrors `Module.quantize()` in the reference's API
@@ -40,7 +44,8 @@ from bigdl_tpu.nn.layers.conv import SpatialConvolution
 from bigdl_tpu.nn.layers.linear import Linear
 from bigdl_tpu.nn.module import Container, Module
 
-__all__ = ["QuantizedLinear", "QuantizedSpatialConvolution", "quantize"]
+__all__ = ["QuantizedLinear", "QuantizedSpatialConvolution", "quantize",
+           "calibrate"]
 
 
 def _quantize_weight(w: np.ndarray, reduce_axes: Tuple[int, ...]):
@@ -56,14 +61,56 @@ def _quantize_weight(w: np.ndarray, reduce_axes: Tuple[int, ...]):
 def _quantize_activation(x, axes=None):
     """Dynamic per-tensor symmetric int8 for activations: returns
     (x_q int8, scale f32 scalar).  Differentiation is unsupported by
-    design (inference path)."""
+    design (inference path).
+
+    This is the SLOW path (BASELINE.md round-6 root cause): the global
+    amax reduce is a full extra read of the activation AND a fusion
+    barrier — the scale feeds the very next op, so XLA cannot fuse the
+    quantize into the producer, costing 2+ full-tensor passes per layer.
+    Calibrated modules (``calibrate``) carry a *static* ``act_scale``
+    instead and never enter here at serve time."""
     amax = jnp.max(jnp.abs(x))
     scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
     q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return q, scale
 
 
-class QuantizedLinear(Module):
+def _quantize_activation_static(x, scale: float):
+    """Calibrated int8: ``scale`` is a Python float — a TRACE CONSTANT,
+    so there is no reduce, no barrier, and the divide/round/clip/convert
+    chain fuses straight into the producing op."""
+    inv = np.float32(1.0 / scale)
+    q = jnp.clip(jnp.round(x * inv), -127, 127).astype(jnp.int8)
+    return q, np.float32(scale)
+
+
+class _ActObserver:
+    """Mixin: per-module activation-range observation + the static
+    quantize/dynamic fallback switch shared by both quantized twins.
+
+    ``act_scale`` (Python float, persisted by BTPU as a plain attr) is
+    the calibrated per-tensor input scale; ``None`` means uncalibrated —
+    the module falls back to the dynamic amax path.  Observation only
+    happens on EAGER forwards (calibration passes); under jit the
+    concrete ``float()`` read would be a tracer leak, so it is skipped
+    by an explicit tracer check, not by trust."""
+
+    def _quantize_input(self, x):
+        d = self.__dict__
+        if d.get("_observing"):
+            import jax.core as _core
+
+            if not isinstance(x, _core.Tracer):
+                amax = float(jnp.max(jnp.abs(x)))
+                d["_observed_amax"] = max(d.get("_observed_amax", 0.0),
+                                          amax)
+        scale = d.get("act_scale")
+        if scale is not None and not d.get("_observing"):
+            return _quantize_activation_static(x, scale)
+        return _quantize_activation(x)
+
+
+class QuantizedLinear(_ActObserver, Module):
     """int8 ``y = x W^T + b`` (``Linear`` twin).  The contraction is
     int8 x int8 -> int32 (``preferred_element_type``), dequantized by
     ``act_scale * w_scale[out]``."""
@@ -73,6 +120,7 @@ class QuantizedLinear(Module):
         super().__init__()
         self.input_size, self.output_size = input_size, output_size
         self.with_bias = bias is not None
+        self.act_scale = None  # calibrated static input scale (float)
         self.register_buffer("weight_q",
                              np.zeros((output_size, input_size), np.int8)
                              if weight_q is None else np.asarray(weight_q))
@@ -92,7 +140,7 @@ class QuantizedLinear(Module):
         return out
 
     def update_output(self, input):
-        x_q, s_x = _quantize_activation(input)
+        x_q, s_x = self._quantize_input(input)
         acc = lax.dot_general(
             x_q, self.weight_q,
             dimension_numbers=(((x_q.ndim - 1,), (1,)), ((), ())),
@@ -103,7 +151,7 @@ class QuantizedLinear(Module):
         return y
 
 
-class QuantizedSpatialConvolution(Module):
+class QuantizedSpatialConvolution(_ActObserver, Module):
     """int8 NCHW convolution (``SpatialConvolution`` twin); weight
     stays OIHW int8, accumulation int32 on the MXU."""
 
@@ -118,6 +166,7 @@ class QuantizedSpatialConvolution(Module):
         self.pad_w, self.pad_h = pad_w, pad_h
         self.n_group = n_group
         self.with_bias = bias is not None
+        self.act_scale = None  # calibrated static input scale (float)
         wshape = (n_output_plane, n_input_plane // n_group,
                   kernel_h, kernel_w)
         self.register_buffer("weight_q",
@@ -147,7 +196,7 @@ class QuantizedSpatialConvolution(Module):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        x_q, s_x = _quantize_activation(x)
+        x_q, s_x = self._quantize_input(x)
         if self.pad_w == -1 or self.pad_h == -1:
             padding = "SAME"
         else:
@@ -197,6 +246,47 @@ def _converter_for(model):
             return None
         return conv
     return None
+
+
+def calibrate(model: Module, batches, margin: float = 1.0) -> Module:
+    """Calibration pass: set **static** activation scales on every
+    quantized module from the observed input ranges (BASELINE.md
+    round-6 fix — the serving-path answer to int8-slower-than-bf16).
+
+    ``model`` is an already-``quantize()``d tree; ``batches`` iterates
+    representative inputs (arrays shaped like inference batches).  Each
+    batch runs one EAGER forward with range observers armed; afterwards
+    every quantized module's ``act_scale`` becomes
+    ``margin * max|input| / 127`` — a Python float, i.e. a trace
+    constant: the per-call global amax reduce (a full extra activation
+    read AND a fusion barrier) disappears from the compiled program,
+    and the quantize chain fuses into the producing op.
+
+    ``margin > 1`` leaves headroom for traffic hotter than the
+    calibration set (out-of-range activations clip at +/-127).
+    Returns the model; re-calibration overwrites the scales."""
+    qmods = [m for m in model.modules() if isinstance(m, _ActObserver)]
+    if not qmods:
+        raise ValueError(
+            "calibrate: no quantized modules found — quantize(model) "
+            "first")
+    for m in qmods:
+        m.__dict__["_observing"] = True
+        m.__dict__["_observed_amax"] = 0.0
+    try:
+        n = 0
+        for x in batches:
+            model.forward(jnp.asarray(x))
+            n += 1
+        if n == 0:
+            raise ValueError("calibrate: empty calibration set")
+    finally:
+        for m in qmods:
+            m.__dict__["_observing"] = False
+    for m in qmods:
+        amax = m.__dict__.pop("_observed_amax", 0.0)
+        m.act_scale = float(margin * amax / 127.0) if amax > 0 else 1.0
+    return model
 
 
 def quantize(model: Module) -> Module:

@@ -1,16 +1,28 @@
 """Predictor (``optim/Predictor.scala:35``, ``optim/LocalPredictor.scala:37``):
-batched inference over datasets/arrays with a compiled forward."""
+batched inference over datasets/arrays with a compiled forward.
+
+Since the serving PR, the compiled step comes from the **bucketed
+executor** (``bigdl_tpu/serving/executor.py``): one process-wide
+compile cache per (model, mesh), shared with the online serving layer.
+This fixes the old behavior of building a fresh ``EvalStep`` — and
+paying a full XLA compile — on every ``predict()`` call: repeated
+predicts, and a Predictor running next to a ``ModelServer`` over the
+same model, all hit the same warm per-shape executables; ragged final
+batches pad onto a batch bucket instead of compiling their own shape.
+
+Multi-input (pytree) models fall back to a per-Predictor cached
+``EvalStep`` — still one compile per shape, never one per call.
+"""
 
 from __future__ import annotations
 
-from typing import Iterable, List
+from typing import List
 
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import DataSet
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
-from bigdl_tpu.parallel.train_step import EvalStep
 
 __all__ = ["LocalPredictor", "Predictor"]
 
@@ -20,6 +32,7 @@ class LocalPredictor:
         self.model = model
         self.batch_size = batch_size
         self.mesh = mesh
+        self._eval_step = None  # pytree-input fallback, cached
 
     def _batches(self, data):
         from bigdl_tpu.dataset.dataset import AbstractDataSet
@@ -36,14 +49,37 @@ class LocalPredictor:
 
                 yield MiniBatch([arr[i:i + self.batch_size]])
 
+    def _executor(self):
+        from bigdl_tpu.serving.executor import executor_for
+
+        return executor_for(self.model, mesh=self.mesh,
+                            max_batch=self.batch_size)
+
+    def _fallback_step(self):
+        """Pytree inputs (multi-input graphs) don't bucket; keep ONE
+        EvalStep per predictor so repeated predicts reuse its jit."""
+        if self._eval_step is None:
+            from bigdl_tpu.parallel.train_step import EvalStep
+
+            self._eval_step = EvalStep(self.model, mesh=self.mesh)
+        return self._eval_step
+
     def predict(self, data) -> np.ndarray:
-        step = EvalStep(self.model, mesh=self.mesh)
+        executor = self._executor()
+        # the model may have trained since the last predict: re-read
+        # params/buffers (identity-checked — unchanged state is free,
+        # and same-shape updates keep every compiled executable)
+        executor.refresh_state()
         was_training = self.model.is_training()
         self.model.evaluate()
         try:
             outs: List[np.ndarray] = []
             for batch in self._batches(data):
-                outs.append(np.asarray(step.run(batch.get_input())))
+                x = batch.get_input()
+                if isinstance(x, (list, tuple)):
+                    outs.append(np.asarray(self._fallback_step().run(x)))
+                else:
+                    outs.append(np.asarray(executor.run(x)))
         finally:
             if was_training:
                 self.model.train()

@@ -1,0 +1,36 @@
+"""Production inference serving (docs/serving.md, ROADMAP item 2).
+
+The batch :class:`~bigdl_tpu.optim.predictor.Predictor` scores datasets;
+this package serves *traffic*: an HTTP frontend feeding a bounded
+request queue, a continuous batcher that coalesces in-flight requests
+under a max-latency + max-batch policy, bucketed padded shapes so
+arrival-size variance never triggers an XLA recompile, and per-bucket
+AOT executables (``jax.jit(...).lower().compile()``) warmed at startup
+so first-request latency is a dispatch, not a compile.
+
+Layering (each usable on its own):
+
+- :mod:`bigdl_tpu.serving.buckets`  — the shape-bucket policy,
+- :mod:`bigdl_tpu.serving.executor` — per-bucket AOT executables over a
+  model's state (shared with the batch ``Predictor`` — one compile
+  cache for offline and online inference),
+- :mod:`bigdl_tpu.serving.batcher`  — bounded queue + continuous
+  batcher with backpressure and graceful drain,
+- :mod:`bigdl_tpu.serving.server`   — the stdlib-HTTP frontend
+  (``POST /v1/predict``, ``/status``, ``/healthz``) on the proven
+  ``telemetry/metrics_http.py`` pattern.
+
+Entry points: ``python -m bigdl_tpu.models.cli serve --model lenet``
+and ``python bench_serving.py`` (the diff-gateable load harness).
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.serving.batcher import ContinuousBatcher, QueueFullError
+from bigdl_tpu.serving.buckets import BucketPolicy
+from bigdl_tpu.serving.executor import BucketedExecutor, executor_for
+from bigdl_tpu.serving.server import ModelServer, get, serve_model
+
+__all__ = ["BucketPolicy", "BucketedExecutor", "executor_for",
+           "ContinuousBatcher", "QueueFullError", "ModelServer",
+           "serve_model", "get"]

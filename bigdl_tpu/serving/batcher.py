@@ -1,0 +1,369 @@
+"""Continuous batcher: bounded queue -> coalesced bucketed dispatches.
+
+The policy is adaptive max-latency / max-batch:
+
+- a dispatch fires as soon as ``max_batch`` rows are assembled, OR
+  ``max_wait_ms`` has passed since the OLDEST waiting request arrived —
+  the deadline is anchored to the first request, so no request's queue
+  wait exceeds ``max_wait_ms`` plus one in-flight batch;
+- while a batch is on the device, arrivals keep queueing; the worker
+  drains whatever is waiting the moment the previous dispatch returns
+  (continuous batching — an idle accelerator never waits out a timer
+  when work is queued, and a busy one coalesces for free);
+- the queue is bounded: past ``queue_limit`` requests, ``submit``
+  raises :class:`QueueFullError` and the HTTP frontend turns it into a
+  429 — backpressure instead of unbounded latency.
+
+Each executed batch emits one ``serve`` telemetry event (rows, bucket,
+queue wait, infer time, padding waste) plus the ``serve/queue_depth``
+gauge and ``serve/requests``/``serve/rejected`` counters — the raw
+material for ``/status`` percentiles and ``telemetry diff``'s serving
+metrics.  Graceful drain: ``stop(drain=True)`` stops admissions,
+finishes every queued request, then parks the worker — the SIGTERM
+path of ``models/cli.py serve``.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Callable, Deque, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import telemetry as _telemetry
+
+__all__ = ["ContinuousBatcher", "QueueFullError", "Request"]
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue is at capacity (HTTP 429)."""
+
+
+class Request:
+    """One enqueued inference request: ``x`` is ``[k, ...feature]``
+    rows (k >= 1).  ``wait()`` blocks until the batch that carried it
+    lands; ``output``/``error`` hold the result.  ``cancel()`` (the
+    frontend's timeout path) tells the worker to DROP the rows instead
+    of computing results nobody will read — under overload, timed-out
+    work must not amplify the overload."""
+
+    __slots__ = ("x", "rows", "enqueued_at", "done", "output", "error",
+                 "queue_ms", "cancelled")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.enqueued_at = time.perf_counter()
+        self.done = threading.Event()
+        self.output: Any = None
+        self.error: Optional[BaseException] = None
+        self.queue_ms: float = 0.0
+        self.cancelled = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class ContinuousBatcher:
+    """Single worker thread coalescing requests into bucketed
+    executor dispatches.  ``runner(batch_x) -> batch_out`` is the
+    executor's ``run`` (already bucket-padding); the batcher only
+    decides WHEN to dispatch and HOW MANY rows ride along."""
+
+    def __init__(self, runner: Callable[[np.ndarray], Any],
+                 max_batch: int = 32, max_wait_ms: float = 5.0,
+                 queue_limit: int = 256,
+                 seq_pad: Optional[Callable[[List[np.ndarray]],
+                                            Tuple[List[np.ndarray],
+                                                  Optional[int]]]] = None,
+                 seq_trim: Optional[Callable[[Any, int, int],
+                                             Any]] = None,
+                 bucket_rows: Optional[Callable[[int, int], int]] = None):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.runner = runner
+        self.max_batch = max_batch
+        self.max_wait_s = max(0.0, max_wait_ms) / 1000.0
+        self.queue_limit = queue_limit
+        # seq bucketing hooks (token models; the server injects both):
+        # seq_pad([x...]) -> (padded [x...], common seq target) before
+        # concatenation; seq_trim(rows_out, orig_len, target) slices a
+        # request's output back to ITS submitted length afterwards
+        self._seq_pad = seq_pad
+        self._seq_trim = seq_trim
+        # (rows, max_batch) -> padded bucket rows, for the padding-waste
+        # stat; the server injects the executor policy's real buckets
+        self._bucket_rows = bucket_rows
+        self._q: "queue.Queue[Request]" = queue.Queue(maxsize=queue_limit)
+        self._stats_lock = threading.Lock()
+        self._lat_ms: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=4096)  # (wall finish time, e2e latency ms)
+        self._queue_ms: Deque[float] = collections.deque(maxlen=4096)
+        self.requests = 0
+        self.rejected = 0
+        self.rows = 0
+        self.batches = 0
+        self.padded_rows = 0
+        self.errors = 0
+        self._draining = False
+        self._stopped = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="bigdl-serve-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, x: np.ndarray) -> Request:
+        """Enqueue ``[k, ...]`` rows; raises :class:`QueueFullError` at
+        capacity or once draining."""
+        if self._draining or self._stopped.is_set():
+            raise QueueFullError("server is draining")
+        req = Request(np.asarray(x))
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._stats_lock:
+                self.rejected += 1
+            _telemetry.counter("serve/rejected", 1)
+            raise QueueFullError(
+                f"request queue at capacity ({self.queue_limit})") from None
+        with self._stats_lock:
+            self.requests += 1
+        _telemetry.counter("serve/requests", 1)
+        _telemetry.gauge("serve/queue_depth", self._q.qsize())
+        return req
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    # -- the worker --------------------------------------------------------
+    def _gather(self) -> List[Request]:
+        """Block for the first request, then coalesce until the batch
+        is full or the oldest request's ``max_wait_ms`` deadline
+        passes.  Requests too big to ride along are left queued for
+        the next batch (FIFO preserved: Queue pops in order and we
+        only peek-ahead by popping, so an oversized pop is carried
+        into the next gather via ``_carry``)."""
+        batch: List[Request] = []
+        rows = 0
+        carry = getattr(self, "_carry", None)
+        if carry is not None:
+            self._carry = None
+            if carry.cancelled:
+                carry.done.set()
+            else:
+                batch.append(carry)
+                rows = carry.rows
+        while not batch:
+            if self._stopped.is_set():
+                return []
+            if self._draining and self._q.empty():
+                return []
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if first.cancelled:  # timed-out client: drop, don't compute
+                first.done.set()
+                continue
+            batch.append(first)
+            rows = first.rows
+        deadline = batch[0].enqueued_at + self.max_wait_s
+        while rows < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            try:
+                if self._q.empty() and remaining <= 0:
+                    break
+                nxt = self._q.get(timeout=max(0.0, remaining)
+                                  if self._q.empty() else 0.0)
+            except queue.Empty:
+                break
+            if nxt.cancelled:
+                nxt.done.set()
+                continue
+            if rows + nxt.rows > self.max_batch:
+                self._carry = nxt  # rides the next batch, order intact
+                break
+            batch.append(nxt)
+            rows += nxt.rows
+        return batch
+
+    def _loop(self) -> None:
+        self._carry = None
+        while True:
+            batch = self._gather()
+            if not batch:
+                if self._stopped.is_set() or \
+                        (self._draining and self._q.empty()
+                         and getattr(self, "_carry", None) is None):
+                    self._stopped.set()
+                    self._idle.set()
+                    return
+                continue
+            self._idle.clear()
+            try:
+                self._execute(batch)
+            finally:
+                self._idle.set()
+
+    def _execute(self, batch: List[Request]) -> None:
+        batch = [r for r in batch if not r.cancelled]
+        if not batch:
+            return
+        t0 = time.perf_counter()
+        rows = sum(r.rows for r in batch)
+        for r in batch:
+            r.queue_ms = (t0 - r.enqueued_at) * 1000.0
+        try:
+            xs = [r.x for r in batch]
+            lens = [x.shape[1] if np.ndim(x) >= 2 else None for x in xs]
+            target = None
+            if self._seq_pad is not None:
+                xs, target = self._seq_pad(xs)
+            x = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
+            out = self.runner(x)
+            infer_ms = (time.perf_counter() - t0) * 1000.0
+            offset = 0
+            for i, r in enumerate(batch):
+                sliced = _slice_rows(out, offset, offset + r.rows)
+                if target is not None and self._seq_trim is not None \
+                        and lens[i] is not None and target > lens[i]:
+                    # the executor saw only the batch-common padded
+                    # length; slice THIS request's output back to the
+                    # length it actually submitted
+                    sliced = self._seq_trim(sliced, lens[i], target)
+                r.output = sliced
+                offset += r.rows
+        except BaseException as e:  # noqa: BLE001 - relayed per request
+            infer_ms = (time.perf_counter() - t0) * 1000.0
+            with self._stats_lock:
+                self.errors += 1
+            for r in batch:
+                r.error = e
+        finally:
+            done_at = time.time()
+            with self._stats_lock:
+                self.batches += 1
+                self.rows += rows
+                bucket = (self._bucket_rows or _next_bucket)(
+                    rows, self.max_batch)
+                self.padded_rows += max(0, bucket - rows)
+                for r in batch:
+                    e2e = (time.perf_counter() - r.enqueued_at) * 1000.0
+                    self._lat_ms.append((done_at, e2e))
+                    self._queue_ms.append(r.queue_ms)
+            for r in batch:
+                r.done.set()
+        tracer = _telemetry.get()
+        if tracer is not None:
+            tracer.emit("serve", size=rows, requests=len(batch),
+                        dur=(time.perf_counter() - t0),
+                        queue_ms=round(max(r.queue_ms for r in batch), 3),
+                        infer_ms=round(infer_ms, 3),
+                        fill=round(rows / self.max_batch, 4))
+            _telemetry.gauge("serve/queue_depth", self._q.qsize())
+
+    # -- stats / lifecycle -------------------------------------------------
+    def stats(self, window_s: float = 60.0) -> dict:
+        now = time.time()
+        with self._stats_lock:
+            recent = [lat for (at, lat) in self._lat_ms
+                      if now - at <= window_s]
+            lat = sorted(recent)
+            qms = list(self._queue_ms)[-len(lat):] if lat else []
+            out = {"requests": self.requests, "rejected": self.rejected,
+                   "rows": self.rows, "batches": self.batches,
+                   "errors": self.errors,
+                   "queue_depth": self._q.qsize(),
+                   "queue_limit": self.queue_limit,
+                   "max_batch": self.max_batch,
+                   "max_wait_ms": self.max_wait_s * 1000.0,
+                   "batch_fill": round(
+                       self.rows / (self.batches * self.max_batch), 4)
+                   if self.batches else None,
+                   "padding_waste": round(
+                       self.padded_rows / max(1, self.rows + self.padded_rows), 4),
+                   "window_s": window_s,
+                   "draining": self._draining}
+        if lat:
+            # rate over the span actually covered by the recent window
+            # (a 3s-old server must not divide 300 requests by 60s)
+            span = min(window_s,
+                       max(0.25, now - min(at for (at, _) in self._lat_ms
+                                           if now - at <= window_s)))
+            out["qps"] = round(len(lat) / span, 2)
+            out["p50_ms"] = round(_pct(lat, 50.0), 3)
+            out["p99_ms"] = round(_pct(lat, 99.0), 3)
+            out["queue_p50_ms"] = round(_pct(sorted(qms), 50.0), 3) \
+                if qms else 0.0
+        return out
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Stop admissions; with ``drain`` finish everything queued
+        first.  Returns True when the worker parked in time."""
+        self._draining = True
+        if not drain:
+            self._stopped.set()
+        self._thread.join(timeout)
+        self._stopped.set()
+        parked = not self._thread.is_alive()
+        # TOCTOU sweep: a submit() that passed the draining check may
+        # have enqueued AFTER the worker saw an empty queue and parked —
+        # those requests were accepted, so the drain contract owes them
+        # an answer.  The worker is dead here, so executing (or failing)
+        # them inline is race-free.
+        leftovers: List[Request] = []
+        carry = getattr(self, "_carry", None)
+        self._carry = None
+        if carry is not None:
+            leftovers.append(carry)
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        if drain and parked:
+            chunk: List[Request] = []
+            rows = 0
+            for r in leftovers:
+                if rows + r.rows > self.max_batch and chunk:
+                    self._execute(chunk)
+                    chunk, rows = [], 0
+                chunk.append(r)
+                rows += r.rows
+            if chunk:
+                self._execute(chunk)
+        else:  # hard stop: fail fast instead of a silent client timeout
+            for r in leftovers:
+                r.error = QueueFullError("server stopped")
+                r.done.set()
+        return parked
+
+
+def _slice_rows(out, lo: int, hi: int):
+    import jax
+
+    return jax.tree.map(lambda a: a[lo:hi], out)
+
+
+def _next_bucket(n: int, cap: int) -> int:
+    b = 1
+    while b < n and b < cap:
+        b *= 2
+    return max(b, n)
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]

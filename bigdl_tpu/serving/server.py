@@ -1,0 +1,361 @@
+"""The serving HTTP frontend — stdlib ``ThreadingHTTPServer`` on the
+pattern ``telemetry/metrics_http.py`` proved (no new dependencies, a
+daemon thread per connection, handler errors never kill the process).
+
+Endpoints:
+
+- ``POST /v1/predict`` — body ``{"inputs": <nested list>}``: one sample
+  (the model's feature shape) or a ``[k, ...]`` micro-batch.  The
+  request rides the bounded queue into the continuous batcher; the
+  response carries outputs plus its own latency split
+  (``{"outputs": ..., "ms": total, "queue_ms": wait}``).  ``429`` when
+  the queue is full (backpressure), ``503`` while draining, ``400`` on
+  shape/JSON errors, ``504`` when a dispatch exceeds the request
+  timeout;
+- ``GET /status``  — serving stats (qps, p50/p99 latency, queue depth,
+  batch fill, padding waste, warm buckets, compile counts) merged with
+  the same profiler/flight/cluster observer block ``/status`` carries
+  on the metrics endpoint — one JSON shape for ``tools/tpu_watch.sh``
+  and humans with curl;
+- ``GET /healthz`` — 200 while serving, **503 once draining** (the
+  load balancer's signal to stop routing here);
+- ``GET /metrics`` — serving gauges in OpenMetrics text, scrape-ready.
+
+Graceful shutdown: SIGTERM flips ``/healthz`` to 503, stops admissions,
+finishes every queued request, then exits 0 (``serve/drain`` instant) —
+a rolling restart drops zero accepted requests.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import telemetry as _telemetry
+from bigdl_tpu.serving.batcher import ContinuousBatcher, QueueFullError
+from bigdl_tpu.serving.buckets import BucketPolicy
+from bigdl_tpu.serving.executor import executor_for
+
+__all__ = ["ModelServer", "serve_model", "get"]
+
+_ACTIVE: Optional["ModelServer"] = None
+
+
+def get() -> Optional["ModelServer"]:
+    """The live server (None outside serving processes) — the accessor
+    ``telemetry/metrics_http.py`` and ``tools/tpu_watch.sh`` read."""
+    return _ACTIVE
+
+
+class ModelServer:
+    """One served model: executor + batcher + HTTP frontend.
+
+    ``input_spec`` is the model's canonical batched input
+    (``jax.ShapeDtypeStruct`` with a leading batch axis — what
+    ``models/registry.input_spec`` returns); its trailing dims are the
+    per-sample feature shape and its dtype gates request parsing.
+    ``seq_buckets`` (token models) buckets the time axis too.
+    """
+
+    def __init__(self, model, input_spec, name: str = "model",
+                 host: str = "0.0.0.0", port: int = 0,
+                 max_batch: int = 32, max_wait_ms: float = 5.0,
+                 queue_limit: int = 256,
+                 batch_buckets=None, seq_buckets=None,
+                 mesh=None, compute_dtype=None,
+                 request_timeout_s: float = 30.0):
+        self.model = model.evaluate()
+        self.name = name
+        self.sample_shape: Tuple[int, ...] = tuple(input_spec.shape[1:])
+        self.dtype = np.dtype(input_spec.dtype)
+        self.request_timeout_s = request_timeout_s
+        seq_axis = 1 if seq_buckets else None
+        policy = BucketPolicy(max_batch=max_batch,
+                              batch_buckets=batch_buckets,
+                              seq_buckets=seq_buckets)
+        self.executor = executor_for(model, mesh=mesh, policy=policy,
+                                     compute_dtype=compute_dtype,
+                                     seq_axis=seq_axis)
+        self.batcher = ContinuousBatcher(
+            self.executor.run, max_batch=max_batch,
+            max_wait_ms=max_wait_ms, queue_limit=queue_limit,
+            seq_pad=self._pad_seqs if seq_buckets else None,
+            seq_trim=self._trim_seq if seq_buckets else None,
+            bucket_rows=self._bucket_rows)
+        self._started_at = time.time()
+        self._term = threading.Event()
+        self._stopped = False
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.model_server = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="bigdl-serve-http",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        self._thread.start()
+        global _ACTIVE
+        _ACTIVE = self
+        _telemetry.instant("serve/started", port=self.port, model=name)
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self) -> float:
+        """AOT-compile every bucket before taking traffic; returns the
+        wall seconds spent (the cold-start cost paid ONCE, up front)."""
+        return self.executor.warmup(self.sample_shape, self.dtype)
+
+    # -- request path ------------------------------------------------------
+    def _pad_seqs(self, xs):
+        """Mixed-length token micro-batches: pad each to the batch's
+        common seq bucket so they concatenate (axis-1 ragged -> one
+        bucketed time axis).  Returns (padded list, common target) —
+        the batcher keeps each request's ORIGINAL length and trims the
+        outputs back via :meth:`_trim_seq`."""
+        target = max(self.executor.policy.seq_bucket(x.shape[1])
+                     for x in xs)
+        return [self.executor.policy.pad(x, x.shape[0], target)
+                for x in xs], target
+
+    def _trim_seq(self, out, orig_len: int, target: int):
+        """Slice one request's output time axis back to its submitted
+        length.  Same guard as the executor's own slice-back: only
+        leaves whose axis 1 equals the padded target are seq-shaped;
+        time-reducing heads ([k, classes]) pass through untouched."""
+        import jax
+
+        def leaf(a):
+            a = np.asarray(a)
+            if a.ndim >= 2 and a.shape[1] == target:
+                return a[:, :orig_len]
+            return a
+
+        return jax.tree.map(leaf, out)
+
+    def _bucket_rows(self, rows: int, cap: int) -> int:
+        try:
+            return self.executor.policy.batch_bucket(min(rows, cap))
+        except ValueError:
+            return rows
+
+    def parse_inputs(self, payload: Dict[str, Any]
+                     ) -> Tuple[np.ndarray, bool]:
+        """-> ([k, ...] rows, was_single_sample).  ONE list->ndarray
+        conversion — the most expensive CPU step on the request path."""
+        if not isinstance(payload, dict) or "inputs" not in payload:
+            raise ValueError('body must be {"inputs": <nested list>}')
+        arr = np.asarray(payload["inputs"], dtype=self.dtype)
+        nd = len(self.sample_shape)
+        single = arr.ndim == nd
+        if single:
+            arr = arr[None]
+        elif arr.ndim != nd + 1:
+            raise ValueError(
+                f"inputs must have {nd} dims (one sample) or {nd + 1} "
+                f"(a [k, ...] micro-batch); got {arr.ndim}")
+        if arr.shape[0] > self.batcher.max_batch:
+            raise ValueError(
+                f"micro-batch of {arr.shape[0]} rows exceeds max_batch "
+                f"{self.batcher.max_batch} — split the request")
+        # fixed feature dims must match exactly; a seq-bucketed time
+        # axis (axis 1) is the one allowed to vary
+        fixed_from = 1 if self.executor.seq_axis is not None else 0
+        if arr.shape[1 + fixed_from:] != self.sample_shape[fixed_from:]:
+            raise ValueError(
+                f"sample shape {arr.shape[1:]} incompatible with the "
+                f"model's {self.sample_shape}")
+        return arr, single
+
+    def predict(self, arr: np.ndarray) -> Tuple[Any, float]:
+        """Submit rows and wait for the carrying batch; returns
+        (outputs, queue_ms).  Raises QueueFullError / TimeoutError."""
+        req = self.batcher.submit(arr)
+        if not req.wait(self.request_timeout_s):
+            # nobody will read the answer: tell the worker to DROP the
+            # rows — under overload, timed-out work must not keep the
+            # device busy amplifying the overload
+            req.cancel()
+            raise TimeoutError(
+                f"no dispatch within {self.request_timeout_s}s")
+        if req.error is not None:
+            raise req.error
+        return req.output, req.queue_ms
+
+    # -- views -------------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        st = self.batcher.stats()
+        st.update(
+            model=self.name, port=self.port,
+            uptime_s=round(time.time() - self._started_at, 3),
+            sample_shape=list(self.sample_shape), dtype=str(self.dtype),
+            batch_buckets=list(self.executor.policy.batch_buckets),
+            seq_buckets=list(self.executor.policy.seq_buckets)
+            if self.executor.policy.seq_buckets else None,
+            warm_buckets=[list(k for k in key if k is not None)
+                          for key in self.executor.warm_buckets()],
+            compiles=self.executor.compile_count,
+            warmup_s=round(self.executor.warmup_s, 3))
+        return st
+
+    def openmetrics(self) -> str:
+        st = self.status()
+        lines = []
+        for key, mtype in (("qps", "gauge"), ("p50_ms", "gauge"),
+                           ("p99_ms", "gauge"), ("queue_depth", "gauge"),
+                           ("requests", "counter"),
+                           ("rejected", "counter"),
+                           ("rows", "counter"), ("batches", "counter"),
+                           ("errors", "counter"),
+                           ("compiles", "counter")):
+            v = st.get(key)
+            if v is None:
+                continue
+            name = f"bigdl_serve_{key}" + (
+                "_total" if mtype == "counter" else "")
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.append(f'{name}{{model="{self.name}"}} {float(v):g}')
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle ---------------------------------------------------------
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain (main thread only; the CLI
+        entry calls this, library users drive ``stop()`` themselves)."""
+        def _on_term(signum, frame):  # noqa: ARG001 - signal contract
+            self._term.set()
+
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+
+    def wait(self) -> None:
+        """Block until SIGTERM/SIGINT (after install_signal_handlers)
+        or ``stop()``."""
+        while not self._term.is_set():
+            self._term.wait(0.5)
+
+    def draining(self) -> bool:
+        return self._term.is_set() or self.batcher._draining \
+            or self._stopped
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Drain (finish queued requests) then park everything; always
+        announced as a ``serve/drain`` instant with the final stats."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._term.set()
+        drained = self.batcher.stop(drain=drain, timeout=timeout)
+        _telemetry.instant("serve/drain", clean=bool(drained),
+                           requests=self.batcher.requests,
+                           rejected=self.batcher.rejected)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _server(self) -> ModelServer:
+        return self.server.model_server  # type: ignore[attr-defined]
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path != "/v1/predict":
+                self.send_error(404)
+                return
+            srv = self._server()
+            if srv.draining():
+                self._json(503, {"error": "draining"})
+                return
+            t0 = time.perf_counter()
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                arr, single = srv.parse_inputs(payload)
+            except (ValueError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                out, queue_ms = srv.predict(arr)
+            except QueueFullError as e:
+                self._json(429, {"error": str(e)})
+                return
+            except TimeoutError as e:
+                self._json(504, {"error": str(e)})
+                return
+            outs = np.asarray(out)
+            if single:
+                outs = outs[0]  # one sample in -> one sample out
+            self._json(200, {
+                "outputs": outs.tolist(),
+                "ms": round((time.perf_counter() - t0) * 1000.0, 3),
+                "queue_ms": round(queue_ms, 3)})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 - the server must survive
+            try:
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:  # noqa: BLE001 - client already gone
+                pass
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        try:
+            srv = self._server()
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path in ("/", "/status"):
+                status: Dict[str, Any] = {"serving": srv.status()}
+                try:
+                    from bigdl_tpu.telemetry.metrics_http import \
+                        _observer_status
+
+                    status.update(_observer_status())
+                except Exception:  # noqa: BLE001 - observers best-effort
+                    pass
+                self._json(200, status)
+            elif path == "/metrics":
+                body = srv.openmetrics().encode("utf-8")
+                self._respond(200, body,
+                              "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                if srv.draining():
+                    self._json(503, {"ok": False, "draining": True})
+                else:
+                    self._json(200, {"ok": True})
+            else:
+                self.send_error(404)
+        except Exception:  # noqa: BLE001 - the server must survive
+            try:
+                self.send_error(500)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _json(self, code: int, obj: Dict[str, Any]) -> None:
+        self._respond(code, (json.dumps(obj, default=str) + "\n"
+                             ).encode("utf-8"), "application/json")
+
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args) -> None:  # per-request stderr stays quiet
+        pass
+
+
+def serve_model(model, input_spec, warmup: bool = True,
+                **kwargs) -> ModelServer:
+    """Build a :class:`ModelServer` and (by default) AOT-warm every
+    bucket before returning — the one-call serving entry point."""
+    server = ModelServer(model, input_spec, **kwargs)
+    if warmup:
+        server.warmup()
+    return server

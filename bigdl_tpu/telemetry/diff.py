@@ -46,6 +46,19 @@ _RULES: List[Tuple[str, str, str]] = [
     ("nonfinite_steps", "lower", "count"),
     (".images_per_sec", "higher", "pct"),
     (".mfu", "higher", "pct"),
+    # serving metrics (bigdl_tpu/serving + bench_serving.py): latency
+    # percentiles regress UP, sustained rate regresses DOWN; steady-
+    # state recompiles and shed load are zero-slack counts — ONE
+    # in-request-path compile is a p99 spike worth failing CI over
+    ("serve_p50_ms", "lower", "pct"),
+    ("serve_p99_ms", "lower", "pct"),
+    ("serve_qps", "higher", "pct"),
+    (".p50_ms", "lower", "pct"),
+    (".p99_ms", "lower", "pct"),
+    (".qps", "higher", "pct"),
+    (".rejected", "lower", "count"),
+    (".steady_compiles", "lower", "count"),
+    (".retrace_diagnostics", "lower", "count"),
 ]
 
 
@@ -94,6 +107,19 @@ def run_log_metrics(path: str) -> Dict[str, Any]:
     health = summary.get("health", {})
     out["health_events"] = sum(health.get("events", {}).values())
     out["nonfinite_steps"] = health.get("nonfinite_steps", 0)
+    # serving runs: fold per-batch `serve` events into the same
+    # latency/rate metrics bench_serving.py emits, so a serve run log
+    # diffs against another run log OR a bench_serving JSON
+    serves = [e for e in events if e.get("kind") == "serve"]
+    if serves:
+        lats = sorted(float(e.get("queue_ms", 0.0))
+                      + float(e.get("infer_ms", 0.0)) for e in serves)
+        out["serve_p50_ms"] = lats[int(0.50 * (len(lats) - 1))]
+        out["serve_p99_ms"] = lats[int(round(0.99 * (len(lats) - 1)))]
+        rows = sum(int(e.get("size", 0)) for e in serves)
+        span = max(e["ts"] for e in serves) - min(e["ts"] for e in serves)
+        if span > 0:
+            out["serve_qps"] = rows / span
     return out
 
 
@@ -108,6 +134,12 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
             out[f"{name}.images_per_sec"] = float(row["images_per_sec"])
         if row.get("mfu") is not None:
             out[f"{name}.mfu"] = float(row["mfu"])
+        # serving rows (bench_serving.py): latency/rate + the zero-
+        # slack steady-state counters
+        for key in ("p50_ms", "p99_ms", "qps", "rejected",
+                    "steady_compiles", "retrace_diagnostics"):
+            if row.get(key) is not None:
+                out[f"{name}.{key}"] = float(row[key])
     if doc.get("value") is not None and not doc.get("configs"):
         out["throughput"] = float(doc["value"])
     if doc.get("mfu") is not None:

@@ -74,6 +74,10 @@ class MetricsSink:
         self.last_fault: Dict[str, Any] = {}  # last fault/injected
         self.quarantined = 0
         self.preempted = False
+        # serving batches (kind "serve", bigdl_tpu/serving/batcher.py)
+        self.serve_batches = 0
+        self.serve_rows = 0
+        self.last_serve: Dict[str, Any] = {}
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -121,6 +125,12 @@ class MetricsSink:
                 self.compiles += 1
             elif kind == "retrace":
                 self.retraces += 1
+            elif kind == "serve":
+                self.serve_batches += 1
+                self.serve_rows += int(event.get("size", 0))
+                self.last_serve = {k: event[k] for k in
+                                   ("size", "queue_ms", "infer_ms",
+                                    "fill") if k in event}
 
     def flush(self) -> None:
         pass
@@ -148,7 +158,10 @@ class MetricsSink:
                     "checkpoint": checkpoint,
                     "last_fault": dict(self.last_fault),
                     "quarantined_checkpoints": self.quarantined,
-                    "preempted": self.preempted}
+                    "preempted": self.preempted,
+                    "serve_batches": self.serve_batches,
+                    "serve_rows": self.serve_rows,
+                    "last_serve": dict(self.last_serve)}
 
     def openmetrics(self) -> str:
         """Prometheus/OpenMetrics exposition text of the current state."""
@@ -204,6 +217,10 @@ class MetricsSink:
                        "seconds since the newest committed checkpoint")
             sample("bigdl_checkpoints_quarantined_total", "counter",
                    self.quarantined, "torn checkpoints quarantined")
+            sample("bigdl_serve_batches_total", "counter",
+                   self.serve_batches, "serving batches executed")
+            sample("bigdl_serve_rows_total", "counter", self.serve_rows,
+                   "serving rows (requests' samples) executed")
             sample("bigdl_compiles_total", "counter", self.compiles,
                    "XLA compiles observed")
             sample("bigdl_retraces_total", "counter", self.retraces,
@@ -244,6 +261,17 @@ def _observer_status() -> Dict[str, Any]:
             # the per-peer heartbeat table (step, age, status, lost
             # reason) — docs/fault_tolerance.md "Distributed failures"
             out["cluster"] = cl.status()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from bigdl_tpu import serving
+
+        srv = serving.get()
+        if srv is not None:
+            # live serving stats (qps, p50/p99, queue depth, warm
+            # buckets) — the same block the serving frontend's own
+            # /status carries, so tpu_watch reads either endpoint
+            out["serving"] = srv.status()
     except Exception:  # noqa: BLE001
         pass
     return out

@@ -49,6 +49,11 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # per-module cost attribution (telemetry/attribution.py): rows is a
     # list of {path, class, flops, flops_fwd, flops_bwd, bytes, params}
     "attribution": {"rows": (list,)},
+    # one per executed serving batch (bigdl_tpu/serving/batcher.py):
+    # size = rows carried, dur = assemble+infer seconds; queue_ms /
+    # infer_ms / fill / requests travel as extra fields — the raw
+    # material for `telemetry diff`'s serve_p50/p99/qps metrics
+    "serve": {"size": (int,), "dur": _NUM},
 }
 
 _BASE: Dict[str, tuple] = {"v": (int,), "ts": _NUM, "pid": (int,),
@@ -63,6 +68,10 @@ STREAM_NAMES = frozenset({
     # spans
     "train/iteration", "data_wait", "validation", "checkpoint",
     "perf/warmup", "perf/timed", "profile/trace", "profile/warmup",
+    # serving (bigdl_tpu/serving/, docs/serving.md): startup AOT warmup
+    # span, server lifecycle instants, queue gauge, admission counters
+    "serve/warmup", "serve/started", "serve/drain", "serve/load",
+    "serve/queue_depth", "serve/requests", "serve/rejected",
     # instants
     "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
     "metrics/serving", "profile/armed", "profile/captured",
@@ -92,9 +101,12 @@ STREAM_NAMES = frozenset({
     "compile + first iteration time", "data time", "validation time",
     "checkpoint time", "checkpoint wait time", "h2d", "dispatch",
     "device",
-    # compile-event names (TrainStep/EvalStep dispatch kinds)
+    # compile-event names (TrainStep/EvalStep dispatch kinds; the
+    # serving executor splits startup warmup compiles from the
+    # in-request-path compiles a healthy server never emits)
     "TrainStep.run", "TrainStep.run_sharded", "TrainStep.run_scan",
     "TrainStep.aot_scan", "EvalStep.run",
+    "ServeExecutor.warmup", "ServeExecutor.compile",
 })
 
 

@@ -46,6 +46,13 @@ PINNED_MODULES = [
     "bigdl_tpu/ops/pool_pallas.py",
     "bigdl_tpu/ops/pooling_pallas.py",
     "bigdl_tpu/ops/attention.py",
+    # the serving layer (ISSUE 8): losing any of these silently reverts
+    # online inference to per-call EvalStep rebuilds (a compile per
+    # predict) and drops the continuous-batching HTTP frontend
+    "bigdl_tpu/serving/buckets.py",
+    "bigdl_tpu/serving/executor.py",
+    "bigdl_tpu/serving/batcher.py",
+    "bigdl_tpu/serving/server.py",
 ]
 
 

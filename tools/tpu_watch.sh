@@ -72,6 +72,24 @@ if st.get("quarantined_checkpoints"):
     line += f" quarantined={st['quarantined_checkpoints']}"
 if st.get("preempted"):
     line += " PREEMPTED"
+# inference serving (bigdl_tpu/serving/): live qps + latency
+# percentiles + queue pressure — a babysitter sees a p99 spike or
+# shed load (429s) without curling the serve port itself; STEADY-
+# STATE compiles above the warm bucket count mean the server is
+# recompiling in production (docs/serving.md runbook entry)
+srv = st.get("serving") or {}
+if srv:
+    line += (f" serve[{srv.get('model', '?')}]:"
+             f"qps={srv.get('qps', 0)}"
+             f" p50={srv.get('p50_ms', '?')}ms"
+             f" p99={srv.get('p99_ms', '?')}ms"
+             f" q={srv.get('queue_depth', 0)}/{srv.get('queue_limit', '?')}"
+             f" fill={srv.get('batch_fill', '?')}"
+             f" compiles={srv.get('compiles', '?')}")
+    if srv.get("rejected"):
+        line += f" rejected={srv['rejected']}"
+    if srv.get("draining"):
+        line += " DRAINING"
 # cluster fault tolerance (parallel/cluster.py): the per-peer heartbeat
 # table — a babysitter sees which host stalled BEFORE the watchdog
 # aborts the collective, and DEGRADED the instant a peer is presumed
