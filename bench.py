@@ -36,8 +36,12 @@ from bigdl_tpu.utils.engine import enable_compile_cache
 # at import so every tool built on bench.make_step (profile_bench,
 # hlo_dump, batch_sweep, the experiments) inherits the persistent
 # executable cache — a cache hit skips the remote-compile RPC, the
-# tunnel's observed wedge point
-enable_compile_cache()
+# tunnel's observed wedge point.  Implicit: accelerator-only (plain
+# CPU opts in via BIGDL_COMPILE_CACHE; see docs/compile.md) and never
+# the first backend touch — probe_backend keeps that role; with the
+# platform undecidable here, the aot_scan-time call enables it before
+# the first real compile anyway
+enable_compile_cache(implicit=True)
 
 from bench_constants import HEADLINE, ROUND3_BEST  # shared with tooling
 
@@ -292,6 +296,11 @@ def _run_config_timed(name, batch, iters):
     telemetry.stage("device", wall - (t_dispatch - t0))
     telemetry.counter(f"bench/{name}/images_per_sec", rate)
     out = {"images_per_sec": round(rate, 2), "batch": batch,
+           # the compile budget's input (docs/compile.md): per-leg
+           # compile seconds as a first-class field so
+           # `--diff-against --compile-budget` gates the lenet-445s
+           # class of outlier instead of it hiding inside stages_s
+           "compile_s": round(compile_s, 3),
            # host-loop stage breakdown (optim/Metrics.scala:31-130
            # re-scope; see docs/straggler.md): compile / h2d / dispatch /
            # device-sync seconds for the timed window
@@ -576,6 +585,13 @@ def main(argv=None):
     ap.add_argument("--diff-threshold-pct", type=float, default=None,
                     help="regression threshold for --diff-against "
                          "(default: the diff engine's)")
+    ap.add_argument("--compile-budget", type=float, default=None,
+                    metavar="PCT",
+                    help="compile budget for --diff-against: a config "
+                         "whose compile_s grew more than PCT%% over the "
+                         "baseline exits 4 like any other regression "
+                         "(default: the diff engine's compile threshold,"
+                         " 50%%)")
     args = ap.parse_args(argv)
     _init_backend_or_die()
     # BIGDL_TELEMETRY routes the sweep's per-config stage timings,
@@ -595,6 +611,8 @@ def main(argv=None):
         kwargs = {}
         if args.diff_threshold_pct is not None:
             kwargs["threshold_pct"] = args.diff_threshold_pct
+        if args.compile_budget is not None:
+            kwargs["compile_threshold_pct"] = args.compile_budget
         rows = tdiff.diff_metrics(base, cur, **kwargs)
         print(tdiff.format_diff(rows, base, cur), file=sys.stderr)
         if not rows:
@@ -654,6 +672,16 @@ def _sweep():
                            and head.get("images_per_sec") else None),
         "configs": results,
     }
+    try:
+        from bigdl_tpu.utils import compile_cache as _cc
+
+        # the sweep's persistent-cache story rides the artifact: a warm
+        # round shows hits ~= requests, and the ingredients explain any
+        # surprise cold round (docs/compile.md)
+        line["compile_cache"] = _cc.monitor().snapshot()
+        line["compile_cache_ingredients"] = _cc.cache_key_ingredients()
+    except Exception:  # noqa: BLE001 — accounting must not sink the sweep
+        pass
     if infer is not None:
         line["infer_int8_vs_bf16"] = infer
     print(json.dumps(line))

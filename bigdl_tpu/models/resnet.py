@@ -82,8 +82,12 @@ _IMAGENET_CFGS = {
 
 
 def build_resnet(depth: int = 50, class_num: int = 1000,
-                 shortcut_type: str = "B") -> nn.Module:
-    """ImageNet ResNet (``ResNet.scala`` apply, dataset=ImageNet)."""
+                 shortcut_type: str = "B",
+                 scan: Optional[bool] = None) -> nn.Module:
+    """ImageNet ResNet (``ResNet.scala`` apply, dataset=ImageNet).
+    ``scan`` stacks each stage's run of identical blocks into one
+    ``nn.ScanLayers`` body — XLA compiles one block per stage instead of
+    one per layer (None = the ``BIGDL_SCAN_LAYERS`` config)."""
     counts, block, n_features = _IMAGENET_CFGS[depth]
     m = nn.Sequential(
         nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False),
@@ -101,11 +105,14 @@ def build_resnet(depth: int = 50, class_num: int = 1000,
     m.add(nn.View(n_features).set_num_input_dims(3))
     m.add(nn.Linear(n_features, class_num))
     m.add(nn.LogSoftMax())
-    return m
+    from bigdl_tpu.nn.layers.scan import maybe_scan
+
+    return maybe_scan(m, scan)
 
 
 def build_resnet_cifar(depth: int = 20, class_num: int = 10,
-                       shortcut_type: str = "A") -> nn.Module:
+                       shortcut_type: str = "A",
+                       scan: Optional[bool] = None) -> nn.Module:
     """CIFAR-10 ResNet (``ResNet.scala`` apply, dataset=CIFAR-10):
     depth = 6n+2 basic blocks."""
     assert (depth - 2) % 6 == 0, "CIFAR depth must be 6n+2"
@@ -124,4 +131,6 @@ def build_resnet_cifar(depth: int = 20, class_num: int = 10,
     m.add(nn.View(64).set_num_input_dims(3))
     m.add(nn.Linear(64, class_num))
     m.add(nn.LogSoftMax())
-    return m
+    from bigdl_tpu.nn.layers.scan import maybe_scan
+
+    return maybe_scan(m, scan)

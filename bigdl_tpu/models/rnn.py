@@ -4,6 +4,8 @@ BASELINE.md config 4)."""
 
 from __future__ import annotations
 
+from typing import Optional
+
 import bigdl_tpu.nn as nn
 
 __all__ = ["build_simple_rnn", "build_lstm_classifier"]
@@ -23,10 +25,15 @@ def build_simple_rnn(input_size: int = 4000, hidden_size: int = 40,
 def build_lstm_classifier(vocab_size: int, embed_dim: int = 128,
                           hidden_size: int = 128, class_num: int = 2,
                           num_layers: int = 1,
-                          one_based_tokens: bool = False) -> nn.Module:
+                          one_based_tokens: bool = False,
+                          scan: Optional[bool] = None) -> nn.Module:
     """LSTM text classification: embedding -> LSTM stack -> last step ->
     dense.  ``num_layers`` stacks LSTMs (each a scan with the fused-gate
-    matmul) — the representative large-model shape for the perf harness."""
+    matmul) — the representative large-model shape for the perf harness.
+    ``scan`` additionally stacks the identical LSTM layers (the 2nd
+    onward when ``embed_dim != hidden_size``) into one ``nn.ScanLayers``
+    body — scan over layers of scan over time, one compiled step cell
+    (None = the ``BIGDL_SCAN_LAYERS`` config; docs/compile.md)."""
     m = nn.Sequential(
         nn.LookupTable(vocab_size, embed_dim, one_based=one_based_tokens))
     in_dim = embed_dim
@@ -36,4 +43,6 @@ def build_lstm_classifier(vocab_size: int, embed_dim: int = 128,
     m.add(nn.Select(1, -1))
     m.add(nn.Linear(hidden_size, class_num))
     m.add(nn.LogSoftMax())
-    return m
+    from bigdl_tpu.nn.layers.scan import maybe_scan
+
+    return maybe_scan(m, scan)

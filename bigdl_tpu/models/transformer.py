@@ -50,11 +50,15 @@ def build_transformer_lm(vocab_size: int, num_layers: int = 4,
                          sp_mesh=None, sp_axis: str = "seq",
                          sp_strategy: str = "ring",
                          sp_batch_axis=None,
-                         remat: bool = False) -> nn.Module:
+                         remat: bool = False,
+                         scan: Optional[bool] = None) -> nn.Module:
     """Causal decoder-only LM over [batch, seq] token ids.
     ``sp_batch_axis`` composes sequence parallelism with data
     parallelism on a 2-D (data, seq) mesh; ``remat`` wraps each block in
-    ``nn.Remat`` so long-context activations are recomputed, not stored."""
+    ``nn.Remat`` so long-context activations are recomputed, not stored.
+    ``scan`` stacks the N identical blocks into one ``nn.ScanLayers``
+    body so XLA compiles ONE block instead of N (None = the
+    ``BIGDL_SCAN_LAYERS`` config; docs/compile.md)."""
     if sp_mesh is not None:
         from bigdl_tpu.parallel.sequence import (
             make_sequence_parallel_attention)
@@ -74,4 +78,6 @@ def build_transformer_lm(vocab_size: int, num_layers: int = 4,
     model.add(nn.LayerNorm(embed_dim))
     model.add(nn.TimeDistributed(nn.Sequential(
         nn.Linear(embed_dim, vocab_size), nn.LogSoftMax())))
-    return model
+    from bigdl_tpu.nn.layers.scan import maybe_scan
+
+    return maybe_scan(model, scan)

@@ -19,6 +19,7 @@ from bigdl_tpu.nn.layers.rnn import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.attention import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.tree import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.moe import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers.scan import *  # noqa: F401,F403
 from bigdl_tpu.nn.quantized import *  # noqa: F401,F403
 from bigdl_tpu.nn.graph import Graph, Input, Node  # noqa: F401
 # TF-style op subpackages stay namespaced (ops.Select vs the Select layer)

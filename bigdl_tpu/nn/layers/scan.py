@@ -1,0 +1,305 @@
+"""Scan-over-layers: N structurally identical blocks as ONE compiled body.
+
+An unrolled ``Sequential`` of N identical blocks makes XLA lower, optimize
+and codegen the block N times — compile time scales with depth while the
+computed program doesn't (docs/compile.md).  :class:`ScanLayers` holds the
+N blocks as one **stacked-param pytree** (every leaf gains a leading
+``[n_layers]`` axis) and runs them with ``jax.lax.scan``, so XLA compiles
+the block once and loops it.  Forward values, gradients and buffer
+updates are exact matches of the unrolled container (rtol ~1e-6 fp32 —
+same ops, same order, per layer).
+
+The stacked layout is also the parameter layout ZeRO-style sharded
+weight updates want (*Automatic Cross-Replica Sharding of Weight
+Update*, arXiv 2004.13336): one ``[n_layers, ...]`` leaf per block
+parameter shards over a mesh axis without per-layer bookkeeping.
+
+Contract (see docs/compile.md):
+
+- **structural identity**: every block must have the same module-class
+  tree, the same param/buffer paths with equal shapes/dtypes, and equal
+  scalar hyperparameters (:func:`layer_signature`).  Construction fails
+  loudly otherwise.
+- **numerics**: the constructor stacks the blocks' EXISTING arrays, so
+  replacing an unrolled run with ``ScanLayers(blocks)`` preserves the
+  model's parameters exactly.
+- **state-dict mapping, both directions**: the stacked tree round-trips
+  through ``state_dict``/BTPU as ``body.<path> -> [n_layers, ...]``;
+  :meth:`ScanLayers.layer_state_dict` / :meth:`load_layer_state_dict`
+  map to/from the per-layer keys (``"<i>.<path>"``) an unrolled
+  ``Sequential`` of the same blocks would use, and :meth:`to_layers`
+  reconstructs the unrolled blocks.
+- **RNG**: stochastic layers (dropout) get an independent stream per
+  scanned layer — the layer index is folded into the step key before
+  the block's own ``_rng_id`` fold, mirroring the unrolled case where
+  every clone owns a distinct id.
+- **attribution**: the body is a real registered submodule (``body``),
+  so PR-4 scope stamping and per-module cost attribution see the
+  scanned block under ``...<scan>.body.<child>`` — once, which is also
+  how often XLA compiles it.
+- **limits**: per-layer differing ``scale_w/scale_b``/freeze masks or
+  hyperparameters cannot be expressed on a stacked run (the signature
+  check rejects them); convert such layers unrolled.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import (Container, Module, Sequential,
+                                 functional_call, load_state_dict,
+                                 state_dict)
+
+__all__ = ["ScanLayers", "layer_signature", "auto_scan", "maybe_scan"]
+
+
+#: per-module __dict__ entries excluded from the behavioral fingerprint:
+#: identity/bookkeeping that legitimately differs between clones of one
+#: block (names, rng ids, scope stamps, timing, trace scratch)
+_SIG_SKIP = frozenset({
+    "_name", "_hyper_version", "_rng_id", "_scope_name", "_bwd_cache",
+    "forward_time", "backward_time", "output", "grad_input",
+    "_last_rng_key", "_last_state", "_init_state_override", "_spatial",
+    "_tele_dispatched", "_dispatch_observed",
+})
+
+
+def _hyper_value(v):
+    """Fingerprint one hyperparameter value: scalars as-is, tuples/
+    lists/sets recursively (shape specs like ``View.sizes`` and
+    ``Transpose.permutations`` MUST participate — two same-class layers
+    differing only in a tuple hyper compute different functions), and
+    anything non-simple (arrays, modules, callables) as an opaque
+    marker so it neither crashes hashing nor falsely distinguishes."""
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,) + tuple(_hyper_value(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return (type(v).__name__,) + tuple(
+            sorted(map(repr, (_hyper_value(x) for x in v))))
+    return f"<{type(v).__name__}>"
+
+
+def layer_signature(module: Module) -> Tuple:
+    """Structural + behavioral fingerprint of a block: the module-class
+    tree, every param/buffer path with shape and dtype, and every
+    simple hyperparameter (scalar or tuple/list-of-scalar ``__dict__``
+    entries outside :data:`_SIG_SKIP`, plus training/frozen flags).
+    Two blocks with equal signatures compute the same function of
+    (params, input) — the precondition for stacking them onto one
+    scanned body."""
+    rows: List[Tuple] = []
+    for name, m in module.named_modules():
+        hyper = tuple(sorted(
+            (k, repr(_hyper_value(v))) for k, v in m.__dict__.items()
+            if k not in _SIG_SKIP
+            and isinstance(v, (int, float, str, bool, type(None),
+                               tuple, list, set, frozenset))))
+        rows.append((name, type(m).__name__, m.__dict__["training"],
+                     m.__dict__["_frozen"], hyper))
+    arrays = tuple(sorted(
+        (path, tuple(jnp.shape(v)), str(getattr(v, "dtype", "?")))
+        for path, v in state_dict(module).items()))
+    return (tuple(rows), arrays)
+
+
+class ScanLayers(Container):
+    """N structurally identical blocks compiled as ONE ``lax.scan`` body.
+
+    ``ScanLayers(b0, b1, ..., bN)`` (or one iterable) takes ownership of
+    ``b0`` as the scan **body** and stacks every block's params/buffers
+    onto it with a leading ``[n_layers]`` axis; the remaining block
+    objects are discarded after their arrays are captured.  Drop-in for
+    the ``Sequential`` run it replaces: same outputs, same grads, same
+    buffer advance (BN running stats update per layer, in order).
+    """
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and not isinstance(layers[0], Module):
+            layers = tuple(layers[0])
+        blocks = list(layers)
+        if not blocks:
+            raise ValueError("ScanLayers needs at least one block")
+        for b in blocks:
+            if not isinstance(b, Module):
+                raise TypeError(f"ScanLayers blocks must be Modules, got "
+                                f"{type(b).__name__}")
+        sig0 = layer_signature(blocks[0])
+        for i, b in enumerate(blocks[1:], 1):
+            if layer_signature(b) != sig0:
+                raise ValueError(
+                    f"ScanLayers block {i} is not structurally identical "
+                    f"to block 0 — stacked scan needs equal module trees, "
+                    f"param shapes/dtypes and scalar hyperparameters")
+        # registration order: the paths exist before stacking mutates them
+        self.n_layers = len(blocks)
+        self.buffer_paths = tuple(sorted(
+            state_dict(blocks[0], kind="buffer")))
+        self.body = blocks[0]
+        states = [state_dict(b) for b in blocks]
+        stacked = {path: jnp.stack([s[path] for s in states])
+                   for path in states[0]}
+        load_state_dict(blocks[0], stacked, strict=False)
+
+    def add(self, module: Module) -> "Container":
+        raise TypeError("ScanLayers is fixed at construction — build a "
+                        "new one from to_layers() + the extra blocks")
+
+    # -- forward -----------------------------------------------------------
+    def update_output(self, input):
+        from bigdl_tpu.utils.rng import current_rng_key
+
+        body = self.__dict__["_modules"]["body"]
+        stacked = state_dict(body)
+        buf_paths = self.buffer_paths
+        key = current_rng_key()
+        if key is not None:
+            # one independent stream per layer: fold the layer index in
+            # BEFORE each stochastic module folds its own _rng_id — the
+            # scanned analogue of every unrolled clone owning its own id
+            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+                jnp.arange(self.n_layers))
+        else:
+            keys = None  # an empty pytree node: scan carries no leaf
+
+        def step(carry, xs_t):
+            layer_state, k = xs_t
+            out, new_state = functional_call(body, layer_state, carry,
+                                             rng=k)
+            updated = {p: new_state[p] for p in buf_paths} or None
+            return out, updated
+
+        # explicit length: a param-less body (e.g. stacked stateless
+        # layers) scans over an empty pytree in eval mode
+        out, new_buffers = lax.scan(step, input, (stacked, keys),
+                                    length=self.n_layers)
+        if new_buffers is not None:
+            # buffer advance (BN running stats): the scan's stacked ys
+            # ARE the per-layer updated buffers; bind them so the outer
+            # functional_call collects them as the new state
+            load_state_dict(body, new_buffers, strict=False)
+        return out
+
+    # -- per-layer state mapping (both directions) -------------------------
+    def layer_state_dict(self):
+        """``{"<i>.<path>": array}`` — the keys an unrolled
+        ``Sequential`` of the same blocks produces from ``state_dict``
+        (the export direction of checkpoint compatibility)."""
+        out = {}
+        stacked = state_dict(self.__dict__["_modules"]["body"])
+        for path, v in stacked.items():
+            for i in range(self.n_layers):
+                out[f"{i}.{path}"] = v[i]
+        return out
+
+    def load_layer_state_dict(self, state, strict: bool = True):
+        """Load per-layer keys (``"<i>.<path>"``, the unrolled
+        ``Sequential`` layout) onto the stacked axis — the import
+        direction.  ``strict`` aggregates all missing/unexpected keys in
+        one ``KeyError``, mirroring ``load_state_dict``."""
+        body = self.__dict__["_modules"]["body"]
+        own = state_dict(body)
+        stacked, missing = {}, []
+        for path in own:
+            rows = []
+            for i in range(self.n_layers):
+                k = f"{i}.{path}"
+                if k in state:
+                    rows.append(jnp.asarray(state[k]))
+                else:
+                    missing.append(k)
+            if len(rows) == self.n_layers:
+                stacked[path] = jnp.stack(rows)
+
+        def _known(k: str) -> bool:
+            head, _, rest = k.partition(".")
+            return head.isdigit() and int(head) < self.n_layers \
+                and rest in own
+
+        unexpected = sorted(k for k in state if not _known(k))
+        if strict and (missing or unexpected):
+            parts = []
+            if missing:
+                parts.append(f"missing per-layer keys: {sorted(missing)}")
+            if unexpected:
+                parts.append(f"unexpected keys: {unexpected}")
+            raise KeyError("; ".join(parts))
+        load_state_dict(body, stacked, strict=False)
+        return self
+
+    def to_layers(self) -> List[Module]:
+        """Reconstruct the N unrolled blocks (fresh modules, slice-``i``
+        arrays) — the inverse of construction."""
+        body = self.__dict__["_modules"]["body"]
+        stacked = state_dict(body)
+        out = []
+        for i in range(self.n_layers):
+            blk = copy.deepcopy(body)
+            load_state_dict(blk, {p: v[i] for p, v in stacked.items()},
+                            strict=False)
+            out.append(blk)
+        return out
+
+    def __repr__(self):
+        return (f"ScanLayers(n_layers={self.__dict__.get('n_layers')}, "
+                f"body={type(self.__dict__['_modules']['body']).__name__})")
+
+
+def auto_scan(model: Module, min_run: int = 2) -> Module:
+    """Rewrite every maximal run of >= ``min_run`` consecutive,
+    structurally identical children of each (exact) ``Sequential``
+    container into one :class:`ScanLayers` — in place, preserving the
+    model's parameter VALUES exactly (the blocks' arrays are stacked,
+    not re-initialized).  Registration indices of later children shift
+    (N blocks collapse to one slot), so convert before checkpointing, or
+    map old checkpoints through ``load_layer_state_dict``.
+
+    Children are processed innermost-first so nested identical runs
+    collapse before the outer comparison sees them.  Only exact
+    ``Sequential`` containers are rewritten: subclasses and table
+    containers (Concat/ConcatTable/...) don't compose children
+    sequentially, so a "run" there is not a chain."""
+    mods = list(model.modules())
+    for m in reversed(mods):  # pre-order reversed ~= innermost first
+        if type(m) is not Sequential:
+            continue
+        children = list(m.__dict__["_modules"].values())
+        new: List[Module] = []
+        i = 0
+        while i < len(children):
+            if isinstance(children[i], ScanLayers):
+                new.append(children[i])
+                i += 1
+                continue
+            sig = layer_signature(children[i])
+            j = i + 1
+            while j < len(children) \
+                    and not isinstance(children[j], ScanLayers) \
+                    and layer_signature(children[j]) == sig:
+                j += 1
+            if j - i >= min_run:
+                new.append(ScanLayers(children[i:j]))
+            else:
+                new.extend(children[i:j])
+            i = j
+        m.__dict__["_modules"] = {str(k): c for k, c in enumerate(new)}
+    return model
+
+
+def maybe_scan(model: Module, scan=None, min_run: int = 2) -> Module:
+    """The registry-flag gate the model builders call: ``scan=None``
+    defers to the ``BIGDL_SCAN_LAYERS`` config (default off — the
+    unrolled build stays byte-identical for existing checkpoints);
+    ``True``/``False`` force."""
+    if scan is None:
+        from bigdl_tpu.utils.config import get_config
+
+        scan = get_config().scan_layers
+    return auto_scan(model, min_run=min_run) if scan else model

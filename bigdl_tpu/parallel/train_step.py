@@ -583,6 +583,13 @@ class TrainStep:
         versions hand back a one-element list instead of the dict (the
         CPU quirk bench.py also guards), and callers get the dict
         contract either way."""
+        # AOT is the path restarts/preemption-resumes pay repeatedly —
+        # a warm restart should LOAD this executable, not rebuild it
+        # (docs/compile.md; implicit: accelerator-only unless
+        # BIGDL_COMPILE_CACHE opts plain CPU in, =0 opts out)
+        from bigdl_tpu.utils.engine import enable_compile_cache
+
+        enable_compile_cache(implicit=True)
         x, y = self._shard_batch(x, y, stacked)
         tracer = _telemetry.get()
         t0 = time.perf_counter()

@@ -114,6 +114,7 @@ def scope_of(op_name: str) -> Tuple[str, str]:
     frames = op_name.split("/")
     kept: List[str] = []
     bwd = False
+    skip_region = 0
     for frame in frames[:-1] if len(frames) > 1 else []:
         while True:
             m = _UNWRAP_RE.match(frame)
@@ -122,6 +123,20 @@ def scope_of(op_name: str) -> Tuple[str, str]:
             if m.group(1) == "transpose":
                 bwd = True
             frame = m.group(2)
+        if skip_region:
+            # the region frame following a control-flow op ("body"/
+            # "cond" after "while") is loop structure, not a module
+            # scope — even when a module is ALSO registered as "body"
+            # (ScanLayers), the structural frame is always the one
+            # directly after "while"
+            skip_region -= 1
+            continue
+        if frame == "while":
+            # lax.scan/while_loop lower their body under "while/body"
+            # (condition under "while/cond"): scan-over-layers scopes
+            # must fold onto the module tree, not vanish into the loop
+            skip_region = 1
+            continue
         if not frame or _CALL_FRAME_RE.match(frame) or frame == "pjit":
             continue  # jit(...)/pjit function frames, not module scopes
         kept.append(frame)

@@ -26,9 +26,18 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["load_metrics", "run_log_metrics", "bench_metrics",
-           "diff_metrics", "format_diff", "DEFAULT_THRESHOLD_PCT"]
+           "diff_metrics", "format_diff", "DEFAULT_THRESHOLD_PCT",
+           "DEFAULT_COMPILE_THRESHOLD_PCT"]
 
 DEFAULT_THRESHOLD_PCT = 10.0
+
+#: compile_s regression threshold (the compile budget, docs/compile.md):
+#: looser than the runtime threshold by design — compile wall time is
+#: noisier run-to-run than step time, and the class of outlier this
+#: gate exists for (lenet 445 s vs a 2.7 s sibling, BENCH_banked_r5) is
+#: an order of magnitude, not ten percent.  ``bench.py --compile-budget``
+#: / ``telemetry diff --compile-threshold-pct`` tighten it per CI leg.
+DEFAULT_COMPILE_THRESHOLD_PCT = 50.0
 
 #: metric name -> (direction, kind); direction "lower"/"higher" is the
 #: GOOD direction, kind "pct" uses the relative threshold, "count" the
@@ -41,6 +50,11 @@ _RULES: List[Tuple[str, str, str]] = [
     ("data_wait_share", "lower", "pct"),
     ("mfu", "higher", "pct"),
     ("compiles", "lower", "count"),
+    # cumulative compile seconds — per run log and per bench config —
+    # gate on the dedicated compile threshold ("pct_compile"), not the
+    # runtime threshold: the compile budget (docs/compile.md)
+    ("compile_s", "lower", "pct_compile"),
+    (".compile_s", "lower", "pct_compile"),
     ("retraces", "lower", "count"),
     ("health_events", "lower", "count"),
     ("nonfinite_steps", "lower", "count"),
@@ -103,6 +117,8 @@ def run_log_metrics(path: str) -> Dict[str, Any]:
     if summary.get("mfu") is not None:
         out["mfu"] = summary["mfu"]
     out["compiles"] = len(summary["compiles"])
+    out["compile_s"] = sum(float(c.get("dur", 0.0))
+                           for c in summary["compiles"])
     out["retraces"] = len(summary["retraces"])
     health = summary.get("health", {})
     out["health_events"] = sum(health.get("events", {}).values())
@@ -134,6 +150,13 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
             out[f"{name}.images_per_sec"] = float(row["images_per_sec"])
         if row.get("mfu") is not None:
             out[f"{name}.mfu"] = float(row["mfu"])
+        # per-leg compile seconds: the explicit field on new rows, the
+        # stages_s breakdown on banked pre-budget artifacts
+        compile_s = row.get("compile_s")
+        if compile_s is None:
+            compile_s = (row.get("stages_s") or {}).get("compile")
+        if compile_s is not None:
+            out[f"{name}.compile_s"] = float(compile_s)
         # serving rows (bench_serving.py): latency/rate + the zero-
         # slack steady-state counters
         for key in ("p50_ms", "p99_ms", "qps", "rejected",
@@ -166,10 +189,16 @@ def load_metrics(path: str) -> Dict[str, Any]:
 # -- comparing ---------------------------------------------------------------
 def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
                  threshold_pct: float = DEFAULT_THRESHOLD_PCT,
-                 count_slack: int = 0) -> List[Dict[str, Any]]:
+                 count_slack: int = 0,
+                 compile_threshold_pct: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
     """Compare metric dicts (A = baseline, B = candidate).  Returns one
     row per comparable metric: ``{name, a, b, delta_pct, better,
-    regressed}``, regressions first."""
+    regressed}``, regressions first.  ``compile_threshold_pct`` is the
+    compile budget applied to ``compile_s`` metrics (None = the default
+    :data:`DEFAULT_COMPILE_THRESHOLD_PCT`)."""
+    if compile_threshold_pct is None:
+        compile_threshold_pct = DEFAULT_COMPILE_THRESHOLD_PCT
     rows: List[Dict[str, Any]] = []
     for name in sorted(set(a) & set(b)):
         rule = _rule_for(name)
@@ -189,6 +218,8 @@ def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
             # zero baseline: any move in the bad direction IS the
             # regression (0 -> anything is an infinite pct change)
             regressed = worse and abs(delta) > 1e-9
+        elif kind == "pct_compile":
+            regressed = worse and abs(delta_pct) > compile_threshold_pct
         else:
             regressed = worse and abs(delta_pct) > threshold_pct
         rows.append({"name": name, "a": va, "b": vb,
@@ -239,6 +270,10 @@ def main(argv=None) -> int:
     p.add_argument("--count-slack", type=int, default=0,
                    help="allowed increase for compile/retrace/health "
                         "counts (default 0)")
+    p.add_argument("--compile-threshold-pct", type=float, default=None,
+                   help="compile budget: relative regression threshold "
+                        "for compile_s metrics (default "
+                        f"{DEFAULT_COMPILE_THRESHOLD_PCT})")
     p.add_argument("--json", action="store_true",
                    help="emit rows as JSON instead of the table")
     args = p.parse_args(argv)
@@ -250,7 +285,8 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     rows = diff_metrics(a, b, threshold_pct=args.threshold_pct,
-                        count_slack=args.count_slack)
+                        count_slack=args.count_slack,
+                        compile_threshold_pct=args.compile_threshold_pct)
     n_regressed = sum(r["regressed"] for r in rows)
     exit_code = 2 if not rows else (1 if n_regressed else 0)
     if args.json:
@@ -262,6 +298,10 @@ def main(argv=None) -> int:
                           "verdict": verdict, "regressions": n_regressed,
                           "compared": len(rows),
                           "threshold_pct": args.threshold_pct,
+                          "compile_threshold_pct":
+                              (args.compile_threshold_pct
+                               if args.compile_threshold_pct is not None
+                               else DEFAULT_COMPILE_THRESHOLD_PCT),
                           "count_slack": args.count_slack,
                           "exit_code": exit_code}, indent=2))
     else:

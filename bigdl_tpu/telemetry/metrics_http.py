@@ -65,6 +65,9 @@ class MetricsSink:
         self.gauges: Dict[str, float] = {}
         self.events: Dict[str, int] = {}    # instant name -> count
         self.compiles = 0
+        self.compile_s = 0.0   # cumulative wall seconds spent compiling
+        self.cache_hits = 0    # persistent compile cache (docs/compile.md)
+        self.cache_misses = 0
         self.retraces = 0
         self.nonfinite_steps = 0
         # fault-tolerance state (docs/fault_tolerance.md): the watcher
@@ -121,8 +124,13 @@ class MetricsSink:
                     self.quarantined += 1
                 elif name == "run/preempted":
                     self.preempted = True
+                elif name == "compile/cache_hit":
+                    self.cache_hits += 1
+                elif name == "compile/cache_miss":
+                    self.cache_misses += 1
             elif kind == "compile":
                 self.compiles += 1
+                self.compile_s += float(event.get("dur", 0.0))
             elif kind == "retrace":
                 self.retraces += 1
             elif kind == "serve":
@@ -153,7 +161,11 @@ class MetricsSink:
                     "health_events": dict(self.events),
                     "counters": dict(self.counters),
                     "gauges": dict(self.gauges),
-                    "compiles": self.compiles, "retraces": self.retraces,
+                    "compiles": self.compiles,
+                    "compile_s": round(self.compile_s, 3),
+                    "compile_cache": {"hits": self.cache_hits,
+                                      "misses": self.cache_misses},
+                    "retraces": self.retraces,
                     "nonfinite_steps": self.nonfinite_steps,
                     "checkpoint": checkpoint,
                     "last_fault": dict(self.last_fault),
@@ -223,6 +235,14 @@ class MetricsSink:
                    "serving rows (requests' samples) executed")
             sample("bigdl_compiles_total", "counter", self.compiles,
                    "XLA compiles observed")
+            sample("bigdl_compile_seconds_total", "counter",
+                   self.compile_s, "cumulative wall seconds compiling")
+            sample("bigdl_compile_cache_hits_total", "counter",
+                   self.cache_hits,
+                   "persistent compile cache hits (this run)")
+            sample("bigdl_compile_cache_misses_total", "counter",
+                   self.cache_misses,
+                   "persistent compile cache misses (this run)")
             sample("bigdl_retraces_total", "counter", self.retraces,
                    "retrace attributions observed")
             for name, count in sorted(self.events.items()):
@@ -253,6 +273,18 @@ def _observer_status() -> Dict[str, Any]:
 
         fr = telemetry.flight_recorder()
         out["flight"] = fr.status() if fr is not None else None
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from bigdl_tpu.utils import compile_cache
+
+        # process-lifetime view (the per-run sink counters above only
+        # see events after the run attached): hits/misses/compile_s
+        # since process start, plus the cache-key ingredients — the
+        # "why was this restart cold" diagnosis surface
+        out["compile_cache_process"] = compile_cache.monitor().snapshot()
+        out["compile_cache_ingredients"] = \
+            compile_cache.cache_key_ingredients()
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -76,6 +76,11 @@ STREAM_NAMES = frozenset({
     "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
     "metrics/serving", "profile/armed", "profile/captured",
     "flight/dump",
+    # managed persistent compile cache (utils/compile_cache.py,
+    # docs/compile.md): one instant per persistent-cache hit/miss (the
+    # per-run counts `telemetry diff` and /metrics key off), plus the
+    # once-per-run cache-key ingredients announcement
+    "compile/cache_hit", "compile/cache_miss", "compile/cache",
     # kernel dispatch (bigdl_tpu/ops/dispatch.py): one instant per
     # TRACE-time backend decision — op, backend (pallas|xla), reason —
     # so attribution can name which backend each module compiled to

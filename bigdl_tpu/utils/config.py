@@ -46,6 +46,7 @@ test (or an embedding application) can inject overrides with
 | cluster_dir            | BIGDL_CLUSTER_DIR           | shared dir for peer heartbeats + commit barrier (parallel/cluster.py; unset = cluster fault tolerance off) |
 | cluster_deadline       | BIGDL_CLUSTER_DEADLINE      | peer-heartbeat deadline seconds (0 = derive from the straggler budget, else 120s) |
 | heartbeat_interval     | BIGDL_HEARTBEAT_INTERVAL    | heartbeat publish/poll throttle seconds (default 1.0) |
+| scan_layers            | BIGDL_SCAN_LAYERS           | build registry models with repeated blocks stacked into ScanLayers (docs/compile.md; default off) |
 
 Performance knobs read directly at their consumer (hardware-tuning
 surface, not part of the typed object because they are read at trace
@@ -57,6 +58,7 @@ time inside jitted-program construction):
 | BIGDL_FLASH_MIN_SEQ   | ops.attention auto-backend threshold (default 512; dense below) |
 | BIGDL_POOL_KERNEL     | ops.pooling_pallas argmax-index pool (off/auto/on/interpret; auto=off — see BASELINE.md postmortem) |
 | BIGDL_COMPILE_CACHE   | Engine.enable_compile_cache persistent XLA executable cache dir |
+| BIGDL_COMPILE_CACHE_MIN_S | Engine.enable_compile_cache min compile seconds for an entry to persist (default 0.1) |
 | BIGDL_SINGLETON_WAIT  | Engine.check_singleton bounded wait (s) for a lock holder |
 | BIGDL_COORDINATOR_TIMEOUT | Engine._init_distributed bounded jax.distributed join (s, default 300; 0 = unbounded) |
 | BIGDL_PEAK_FLOPS      | telemetry.device MFU denominator override (FLOP/s per device) |
@@ -151,6 +153,10 @@ class BigDLConfig:
     cluster_dir: Optional[str] = None
     cluster_deadline: float = 0.0
     heartbeat_interval: float = 1.0
+    # scan-over-layers (nn/layers/scan.py, docs/compile.md): build the
+    # registry models with repeated-block runs stacked into ScanLayers
+    # so XLA compiles ONE block body instead of N
+    scan_layers: bool = False
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -206,6 +212,7 @@ class BigDLConfig:
             cluster_dir=env.get("BIGDL_CLUSTER_DIR") or None,
             cluster_deadline=_float("BIGDL_CLUSTER_DEADLINE", 0.0),
             heartbeat_interval=_float("BIGDL_HEARTBEAT_INTERVAL", 1.0),
+            scan_layers=_truthy(env.get("BIGDL_SCAN_LAYERS")),
         )
 
 

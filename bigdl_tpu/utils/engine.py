@@ -36,8 +36,9 @@ from bigdl_tpu.utils.config import get_config
 __all__ = ["Engine", "honor_platform_request", "enable_compile_cache"]
 
 
-def enable_compile_cache(path: str = None) -> str:
-    """Turn on JAX's persistent executable cache (no-op if already set).
+def enable_compile_cache(path: str = None, implicit: bool = False) -> str:
+    """Turn on JAX's persistent executable cache (no-op if already set)
+    and install the hit/miss monitor (``utils/compile_cache.py``).
 
     Re-runs then LOAD the serialized executable instead of re-compiling
     — which besides the usual compile-latency win matters doubly under a
@@ -47,25 +48,84 @@ def enable_compile_cache(path: str = None) -> str:
     environment bootstrap in ``utils/Engine.scala:165`` owns
     process-wide runtime knobs the same way.
 
+    The cache is MANAGED, not just enabled (docs/compile.md): every hit
+    and miss is counted (and mirrored into the telemetry run as
+    ``compile/cache_hit``/``compile/cache_miss`` instants), and the
+    cache-key ingredients are announced per run so a cold restart that
+    should have been warm is diagnosable.  Callers on the paths that
+    repay warm restarts invoke this themselves — ``TrainStep.aot_scan``
+    (restart/preemption-resume compile), ``BucketedExecutor.warmup``
+    (serving cold start) and bench.py at import.
+
     ``path`` defaults to ``BIGDL_COMPILE_CACHE`` (set to ``0``/empty to
-    disable) else ``~/.cache/bigdl_tpu/xla``.  Returns the directory
-    (or ``""`` when disabled)."""
+    disable) else ``~/.cache/bigdl_tpu/xla``; the entry floor defaults
+    to 0.1 s compile time (``BIGDL_COMPILE_CACHE_MIN_S`` overrides —
+    the jax default 1 s floor skips little probe programs whose
+    wedge-window removal is exactly what we want).  Returns the
+    directory (or ``""`` when disabled).
+
+    ``implicit=True`` is the hot-path spelling (aot_scan, serving
+    warmup): it additionally requires EITHER an accelerator backend or
+    an explicit ``BIGDL_COMPILE_CACHE`` opt-in before touching the
+    cache.  On this jaxlib, (de)serializing CPU executables built under
+    a forced multi-device host platform (the tier-1 rig's
+    ``--xla_force_host_platform_device_count=8``) segfaults inside XLA —
+    and plain CPU pays no compile bill worth caching anyway, so the
+    implicit path stays out of the blast radius while TPU/GPU restarts
+    get the cache without configuration."""
+    from bigdl_tpu.utils import compile_cache as _cc
+
     env = os.environ.get("BIGDL_COMPILE_CACHE")
     if env is not None and env.strip() in ("", "0", "off", "false"):
+        # cache OFF is exactly when the compile bill needs measuring
+        # (e.g. disabled to rule out a corrupt cache mid-incident):
+        # keep the compile_s accounting alive
+        _cc.monitor().install()
         return ""
+    if implicit and env is None:
+        # Platform WITHOUT initializing the backend: an import-time
+        # implicit call (bench.py) must not become the first device
+        # touch — probe_backend owns that, with its wedge/singleton
+        # guards.  An already-initialized backend answers exactly;
+        # otherwise trust the env request; with neither, DEFER — the
+        # post-init implicit callers (aot_scan, serving warmup) run
+        # again before the first real compile and enable it then.
+        platform = _cc.initialized_platform()
+        if platform is None:
+            req = (os.environ.get("JAX_PLATFORMS")
+                   or os.environ.get("JAX_PLATFORM_NAME") or "").strip()
+            platform = req.split(",")[0].strip().lower() or None
+        if platform is None or platform == "cpu":
+            _cc.monitor().install()  # compile_s still counts, cache off
+            return ""
+
     path = path or env or os.path.join(
         os.path.expanduser("~"), ".cache", "bigdl_tpu", "xla")
     import jax
 
     if jax.config.jax_compilation_cache_dir:  # user already configured
+        _cc.monitor().install()
         return jax.config.jax_compilation_cache_dir
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # cache every executable that took real compile work (the default
-    # 1s floor skips little probe programs whose wedge-window removal
-    # is exactly what we want)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    try:
+        min_s = float(os.environ.get("BIGDL_COMPILE_CACHE_MIN_S", "0.1"))
+    except ValueError:
+        min_s = 0.1
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        # jax memoizes its cache-enabled check on the FIRST compile of
+        # the process (is_cache_used's _cache_checked latch) — any jit
+        # that ran before this call (model construction, a probe) would
+        # otherwise have silently pinned "no cache" for process
+        # lifetime.  reset the latch so the next compile re-evaluates.
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:  # noqa: BLE001 - older jax: latch absent, no-op
+        pass
+    _cc.monitor().install()
     return path
 
 

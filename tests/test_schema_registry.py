@@ -28,7 +28,12 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           "bigdl_tpu/serving/buckets.py",
           "bigdl_tpu/serving/executor.py",
           "bigdl_tpu/serving/batcher.py",
-          "bigdl_tpu/serving/server.py"]
+          "bigdl_tpu/serving/server.py",
+          # compile-time war (ISSUE 9): scan-over-layers + the managed
+          # persistent compile cache — a silent drop reverts models to
+          # N-times-unrolled lowering and unmeasured cache traffic
+          "bigdl_tpu/nn/layers/scan.py",
+          "bigdl_tpu/utils/compile_cache.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

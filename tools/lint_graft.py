@@ -53,6 +53,12 @@ PINNED_MODULES = [
     "bigdl_tpu/serving/executor.py",
     "bigdl_tpu/serving/batcher.py",
     "bigdl_tpu/serving/server.py",
+    # compile-time war (ISSUE 9): losing scan.py silently reverts the
+    # registry models to N-times-unrolled lowering; losing
+    # compile_cache.py blinds the persistent cache (hits/misses/compile
+    # budget become unmeasured again)
+    "bigdl_tpu/nn/layers/scan.py",
+    "bigdl_tpu/utils/compile_cache.py",
 ]
 
 

@@ -47,6 +47,21 @@ line = (f"status: step={step.get('step', '?')} loss={step.get('loss', '?')} "
         f"throughput={step.get('throughput', '?')} "
         f"nonfinite={st.get('nonfinite_steps', 0)} "
         f"compiles={st.get('compiles', 0)}")
+# managed compile cache (docs/compile.md): cumulative compile seconds
+# + persistent-cache hit/miss — a babysitter sees at a glance whether a
+# restart's compile bill is being paid in cash or from the cache
+if st.get("compile_s"):
+    line += f" compile_s={st['compile_s']}"
+cache = st.get("compile_cache") or {}
+proc_cache = st.get("compile_cache_process") or {}
+# fall back to the process-lifetime pair only as a PAIR — mixing one
+# scope's hits with the other's misses prints a ratio belonging to
+# neither run
+if not (cache.get("hits") or cache.get("misses")):
+    cache = proc_cache
+hits, misses = cache.get("hits", 0), cache.get("misses", 0)
+if hits or misses:
+    line += f" cache={hits}h/{misses}m"
 # on-demand profiler + flight recorder (telemetry/profiler.py,
 # telemetry/flight.py): show a capture in flight / the last artifacts so
 # a sweep babysitter knows a POST /profile actually landed
