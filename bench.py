@@ -317,6 +317,25 @@ def _run_config_timed(name, batch, iters):
         peak = peak_flops_per_sec()
         if peak:
             out["mfu"] = round(achieved / peak, 4)
+    # comms snapshot off the scan executable (telemetry/comms.py): the
+    # scan body holds each collective once, so these are per-iteration
+    # numbers — `--diff-against` then gates bytes-moved regressions
+    # (.comms_bytes/.comms_s) exactly like MFU, which is what the
+    # ZeRO/pipeline PRs need to prove "the reduce-scatter is hidden"
+    try:
+        from bigdl_tpu.telemetry import comms as _comms
+
+        cf = _comms.comms_facts(step._scan_cache[1], mesh=step.mesh,
+                                model=step.model)
+        if cf["count"] or step.mesh is not None:
+            out["comms_bytes"] = cf["bytes"]
+            out["comms_collectives"] = cf["count"]
+            if cf.get("by_axis"):
+                out["comms_by_axis"] = cf["by_axis"]
+            if cf.get("expected_s") is not None:
+                out["comms_s"] = round(cf["expected_s"], 6)
+    except Exception:  # noqa: BLE001 - the snapshot is an observer
+        pass
     return out
 
 

@@ -434,11 +434,21 @@ def cmd_summary(args) -> None:
 
 
 def cmd_attribute(args) -> None:
-    """Per-module FLOPs/bytes table (telemetry/attribution.py)."""
+    """Per-module FLOPs/bytes table (telemetry/attribution.py), or the
+    per-collective comms view (telemetry/comms.py) with ``--comms``."""
     import json
 
     from bigdl_tpu.telemetry import attribution
 
+    if args.comms:
+        from bigdl_tpu.telemetry import comms
+
+        result = comms.attribute_comms_model(
+            args.model, batch=args.batch_size, devices=args.mesh,
+            sync=args.sync)
+        print(json.dumps(result, indent=2, default=str) if args.json
+              else comms.format_comms(result))
+        return
     result = attribution.attribute_model(
         args.model, batch=args.batch_size, train=not args.forward)
     if args.json:
@@ -578,11 +588,21 @@ def main(argv=None) -> None:
     sm.set_defaults(fn=cmd_summary)
 
     at = sub.add_parser("attribute", help="per-module FLOPs/bytes cost "
-                                          "attribution table")
+                                          "attribution table (--comms: "
+                                          "per-collective bytes/axes)")
     common(at)
     at.add_argument("--forward", action="store_true",
                     help="attribute the inference forward instead of "
                          "the full train step")
+    at.add_argument("--comms", action="store_true",
+                    help="per-collective comms view: bytes moved, mesh "
+                         "axes, owning modules (telemetry/comms.py)")
+    at.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="(--comms) data-axis mesh size to shard over "
+                         "(default: all local devices)")
+    at.add_argument("--sync", default="allreduce",
+                    choices=("allreduce", "sharded", "fsdp"),
+                    help="(--comms) parameter_sync mode to compile with")
     at.add_argument("--json", action="store_true")
     # same default batch as `python -m bigdl_tpu.telemetry attribute`:
     # the two front-ends of one table must print the same numbers

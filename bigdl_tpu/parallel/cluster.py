@@ -352,10 +352,20 @@ class ClusterMonitor:
                           process_index=self.process_index)
         recorder = telemetry.flight_recorder()
         if recorder is not None:
+            evidence = {"lost": reasons, "peer_table": snapshot,
+                        "deadline_s": self.deadline}
             try:
-                recorder.dump("peer_lost", {"lost": reasons,
-                                            "peer_table": snapshot,
-                                            "deadline_s": self.deadline})
+                # the coordinator's live fleet table (telemetry/fleet.py)
+                # names WHO was dragging and WHY (data-wait vs comms vs
+                # checkpoint) in the steps leading into the loss — the
+                # flight ring also carries its cluster/skew instants
+                fw = telemetry.fleet_watcher()
+                if fw is not None:
+                    evidence["fleet"] = fw.snapshot()
+            except Exception:  # noqa: BLE001 - dying process
+                pass
+            try:
+                recorder.dump("peer_lost", evidence)
             except Exception:  # noqa: BLE001 - dying process
                 pass
         if not self.abort:

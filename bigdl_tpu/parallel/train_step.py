@@ -490,31 +490,71 @@ class TrainStep:
 
         cfg = get_config()
         level = cfg.telemetry_device
-        if level == "off":
+        comms_on = self._comms_enabled(cfg)
+        if level == "off" and not comms_on:
             return
-        try:
+
+        def relower():
             largs = (self.params, self.opt_state, self.buffers, x, y, key)
             if self.grad_fault:
                 largs += (jnp.float32(1.0),)
-            lowered = self._compiled.lower(*largs)
-            facts = _tdev.collect_device_facts(
-                lowered, (self.params, self.opt_state, self.buffers),
-                level=level)
-        except Exception:  # noqa: BLE001 - facts must never fail the step
-            return
-        if facts:
-            tracer.emit("device_facts", facts=facts)
-        if cfg.telemetry_attribution and cfg.module_scopes:
-            # per-module cost rows from the SAME lowered program — a
-            # StableHLO text parse, no extra XLA compile
-            try:
-                from bigdl_tpu.telemetry import attribution as _attr
+            return self._compiled.lower(*largs)
 
-                payload = _attr.attribute_lowered(lowered, self.model)
+        lowered = None
+        if level != "off":
+            try:
+                lowered = relower()
+                facts = _tdev.collect_device_facts(
+                    lowered, (self.params, self.opt_state, self.buffers),
+                    level=level)
+            except Exception:  # noqa: BLE001 - facts never fail the step
+                facts = None
+            if facts:
+                tracer.emit("device_facts", facts=facts)
+            if lowered is not None and cfg.telemetry_attribution \
+                    and cfg.module_scopes:
+                # per-module cost rows from the SAME lowered program — a
+                # StableHLO text parse, no extra XLA compile
+                try:
+                    from bigdl_tpu.telemetry import attribution as _attr
+
+                    payload = _attr.attribute_lowered(lowered, self.model)
+                    payload["program"] = "train_step"
+                    tracer.emit("attribution", **payload)
+                except Exception:  # noqa: BLE001 - an observer
+                    pass
+        if comms_on:
+            # per-collective comms rows need the POST-SPMD-partitioning
+            # HLO (collectives don't exist in the lowered StableHLO), so
+            # this pays one extra LOCAL XLA compile per step object —
+            # the same class of cost as BIGDL_TELEMETRY_DEVICE=full,
+            # and why `auto` fires only on multi-device meshes.
+            # Independent of the device-facts level: BIGDL_COMMS has its
+            # own off switch, and TELEMETRY_DEVICE=off must not mute it.
+            try:
+                from bigdl_tpu.telemetry import comms as _comms
+
+                if lowered is None:
+                    lowered = relower()
+                payload = _comms.comms_facts(lowered.compile(),
+                                             mesh=self.mesh,
+                                             model=self.model)
                 payload["program"] = "train_step"
-                tracer.emit("attribution", **payload)
-            except Exception:  # noqa: BLE001 - attribution is an observer
+                tracer.emit("comms", **payload)
+            except Exception:  # noqa: BLE001 - comms is an observer
                 pass
+
+    def _comms_enabled(self, cfg) -> bool:
+        """Whether this step emits the per-collective ``comms`` event
+        (docs/observability.md): ``BIGDL_COMMS`` on = always, off =
+        never, auto = only when the mesh spans more than one device —
+        the one case the compiled program contains collectives."""
+        mode = (cfg.telemetry_comms or "auto").strip().lower()
+        if mode in ("0", "off", "false", "no"):
+            return False
+        if mode in ("1", "on", "true", "yes"):
+            return True
+        return self.mesh is not None and self.mesh.devices.size > 1
 
     def _shard_batch(self, x, y, stacked: bool = False):
         if self.mesh is None:
@@ -612,6 +652,19 @@ class TrainStep:
                 facts.update(_tdev.memory_facts(compiled))
                 if facts:
                     tracer.emit("device_facts", facts=facts)
+            if self._comms_enabled(get_config()):
+                # the scan executable is in hand: comms facts are a
+                # text parse here, no extra compile (the scan BODY holds
+                # each collective once — already per-iteration numbers)
+                try:
+                    from bigdl_tpu.telemetry import comms as _comms
+
+                    payload = _comms.comms_facts(compiled, mesh=self.mesh,
+                                                 model=self.model)
+                    payload["program"] = "aot_scan"
+                    tracer.emit("comms", **payload)
+                except Exception:  # noqa: BLE001 - comms is an observer
+                    pass
         from bigdl_tpu.telemetry.device import normalize_cost_analysis
         return normalize_cost_analysis(compiled.cost_analysis())
 

@@ -32,13 +32,15 @@ from bigdl_tpu.telemetry.tracer import (SCHEMA_VERSION, JsonlSink,
 
 __all__ = ["SCHEMA_VERSION", "Tracer", "JsonlSink", "MemorySink",
            "enabled", "get", "start_run", "end_run", "run", "maybe_run",
-           "last_run_path", "metrics_server", "flight_recorder", "span",
+           "last_run_path", "metrics_server", "flight_recorder",
+           "fleet_watcher", "span",
            "stage", "counter", "gauge", "instant", "emit"]
 
 _active: Optional[Tracer] = None
 _last_run_path: Optional[str] = None
 _metrics_server = None
 _flight = None
+_fleet = None
 _lifecycle_lock = threading.Lock()
 
 
@@ -74,6 +76,14 @@ def flight_recorder():
     return _flight
 
 
+def fleet_watcher():
+    """The live cross-host fleet aggregator bound to the active run, or
+    None (non-coordinator process, single-process run,
+    ``BIGDL_FLEET_INTERVAL=0``, or no JSONL dir to tail).  ``.snapshot()``
+    is the /status ``fleet`` block (telemetry/fleet.py)."""
+    return _fleet
+
+
 def _default_meta() -> Dict[str, Any]:
     meta: Dict[str, Any] = {"schema": SCHEMA_VERSION}
     try:  # device facts are best-effort: telemetry must work sans jax
@@ -97,7 +107,7 @@ def start_run(path_or_dir: Optional[str] = None,
     ``run-<stamp>-<pid>.jsonl``; None writes to no file (pass ``sinks``,
     e.g. a MemorySink, instead).  Raises if a run is already active —
     nested runs would interleave two schedules into one file."""
-    global _active, _last_run_path, _metrics_server, _flight
+    global _active, _last_run_path, _metrics_server, _flight, _fleet
     with _lifecycle_lock:
         if _active is not None:
             raise RuntimeError("a telemetry run is already active; "
@@ -105,6 +115,7 @@ def start_run(path_or_dir: Optional[str] = None,
         full_meta = _default_meta()
         full_meta.update(meta or {})
         all_sinks = list(sinks or [])
+        run_dir = None
         if path_or_dir is not None:
             path = path_or_dir
             if not path.endswith(".jsonl"):
@@ -115,6 +126,7 @@ def start_run(path_or_dir: Optional[str] = None,
                     f"run-{stamp}-p{pidx}-{os.getpid()}.jsonl")
             all_sinks.append(JsonlSink(path))
             _last_run_path = path
+            run_dir = os.path.dirname(os.path.abspath(path))
         _flight = _maybe_flight()
         if _flight is not None:
             all_sinks.append(_flight)
@@ -122,6 +134,7 @@ def start_run(path_or_dir: Optional[str] = None,
         tracer.start()
         _active = tracer
         _metrics_server = _maybe_serve_metrics(tracer)
+        _fleet = _maybe_fleet(run_dir, full_meta)
         return tracer
 
 
@@ -164,14 +177,48 @@ def _maybe_serve_metrics(tracer):
         return None
 
 
+def _maybe_fleet(run_dir, meta):
+    """A live FleetWatcher over the run-log directory, coordinator of a
+    multi-process run only (``BIGDL_FLEET_INTERVAL`` seconds poll; 0
+    disables).  Non-coordinators write their log and are tailed by the
+    coordinator's watcher — one aggregator per fleet."""
+    from bigdl_tpu.utils.config import get_config
+
+    interval = get_config().fleet_interval
+    if run_dir is None or interval <= 0:
+        return None
+    if meta.get("process_index", 0) != 0 \
+            or meta.get("process_count", 1) < 2:
+        return None
+    try:
+        from bigdl_tpu.telemetry.fleet import FleetWatcher
+
+        return FleetWatcher(run_dir, interval).start()
+    except Exception:  # noqa: BLE001 - observers never kill the run
+        return None
+
+
 def end_run() -> None:
     """Close the active run (flushes and closes sinks, stops the metrics
-    endpoint); no-op when no run is active."""
-    global _active, _metrics_server, _flight
+    endpoint and the fleet watcher); no-op when no run is active."""
+    global _active, _metrics_server, _flight, _fleet
+    if _fleet is not None:
+        try:
+            # one final poll under the still-open tracer so a short
+            # run's last flushed events make it into the fleet gauges
+            _fleet.poll_once()
+        except Exception:  # noqa: BLE001
+            pass
     with _lifecycle_lock:
         tracer, _active = _active, None
         server, _metrics_server = _metrics_server, None
+        watcher, _fleet = _fleet, None
         _flight = None
+    if watcher is not None:
+        try:
+            watcher.stop()
+        except Exception:  # noqa: BLE001 - shutdown must never raise
+            pass
     if server is not None:
         try:
             server.stop()

@@ -11,24 +11,36 @@ Options::
     python -m bigdl_tpu.telemetry run.jsonl --chrome t.json  # chrome://tracing
     python -m bigdl_tpu.telemetry run.jsonl --validate       # schema check
     python -m bigdl_tpu.telemetry p0.jsonl p1.jsonl ...      # fleet view
+    python -m bigdl_tpu.telemetry p0.jsonl p1.jsonl --chrome fleet.json
+    python -m bigdl_tpu.telemetry fleet <dir> [--watch]      # live fleet table
     python -m bigdl_tpu.telemetry diff old.jsonl new.jsonl   # regression
     python -m bigdl_tpu.telemetry diff old_bench.json new_bench.json
     python -m bigdl_tpu.telemetry attribute --model lenet    # per-module cost
     python -m bigdl_tpu.telemetry attribute run.jsonl        # from a run log
+    python -m bigdl_tpu.telemetry attribute --comms --model lenet --mesh 2
+    python -m bigdl_tpu.telemetry attribute --comms run.jsonl  # comms view
 
 Passing several run logs merges them into the multi-host fleet view
-(per-process step progress + step-skew).  ``diff`` compares two runs
-(JSONL logs or bench.py JSON, mixed freely) and exits nonzero when the
-candidate regressed beyond the thresholds — the CI gate.  ``attribute``
-prints the per-module FLOPs/bytes table — computed fresh for a registry
-model (``--model``, CPU-friendly: lower + parse, no run needed) or read
-back from a run log's ``attribution`` event.
+(per-process step progress + step-skew + blame); ``--chrome`` then
+writes ONE trace with a pid lane per process, viewable as a fleet
+timeline in Perfetto.  ``fleet`` tails/aggregates a telemetry DIRECTORY
+(one-shot or ``--watch``) — the offline twin of the coordinator's live
+``/status`` fleet block.  ``diff`` compares two runs (JSONL logs or
+bench.py JSON, mixed freely) and exits nonzero when the candidate
+regressed beyond the thresholds — the CI gate.  ``attribute`` prints
+the per-module FLOPs/bytes table — computed fresh for a registry model
+(``--model``, CPU-friendly: lower + parse, no run needed) or read back
+from a run log's ``attribution`` event; ``--comms`` switches to the
+per-collective view (bytes moved, mesh axes, owning modules, bandwidth
+vs ``BIGDL_PEAK_BW``), enriched with measured per-collective wall time
+when the log names a perfetto profiler capture that still exists.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from bigdl_tpu.telemetry import schema
@@ -46,20 +58,56 @@ def attribute_main(argv) -> int:
 
     p = argparse.ArgumentParser(
         prog="bigdl_tpu.telemetry attribute",
-        description="per-module FLOPs/bytes attribution table")
+        description="per-module FLOPs/bytes attribution table "
+                    "(--comms: per-collective bytes/axes/bandwidth)")
     p.add_argument("run", nargs="?", default=None, metavar="run.jsonl",
                    help="read the attribution event back from a run log "
-                        "(recorded with BIGDL_ATTRIBUTION=1)")
+                        "(recorded with BIGDL_ATTRIBUTION=1; comms "
+                        "events are on by default for sharded steps)")
     p.add_argument("--model", default=None,
                    help="compute fresh for a registry model instead")
     p.add_argument("-b", "--batch", type=int, default=8)
     p.add_argument("--forward", action="store_true",
                    help="attribute the inference forward instead of the "
                         "full train step")
+    p.add_argument("--comms", action="store_true",
+                   help="per-collective comms view: bytes moved, mesh "
+                        "axes, owning modules, bandwidth vs "
+                        "BIGDL_PEAK_BW")
+    p.add_argument("--mesh", type=int, default=0, metavar="N",
+                   help="(--comms --model) data-axis mesh size to shard "
+                        "over (default: all local devices)")
+    p.add_argument("--sync", default="allreduce",
+                   choices=("allreduce", "sharded", "fsdp"),
+                   help="(--comms --model) parameter_sync mode to "
+                        "compile with")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
     if (args.run is None) == (args.model is None):
         p.error("pass exactly one of run.jsonl or --model NAME")
+    if args.comms:
+        from bigdl_tpu.telemetry import comms as comms_mod
+
+        if args.model is not None:
+            result = comms_mod.attribute_comms_model(
+                args.model, batch=args.batch, devices=args.mesh,
+                sync=args.sync)
+        else:
+            events, parse_errors = schema.read_events(args.run)
+            for e in parse_errors:
+                print(f"warning: {args.run}: {e}", file=sys.stderr)
+            result = comms_mod.comms_from_events(events)
+            if result is None:
+                print(f"error: {args.run} has no comms event (sharded "
+                      f"steps emit one by default; BIGDL_COMMS=on "
+                      f"forces it, or use --model)", file=sys.stderr)
+                return 2
+            _enrich_measured(result, events)
+        if args.json:
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            print(comms_mod.format_comms(result))
+        return 0
     if args.model is not None:
         result = attribution.attribute_model(
             args.model, batch=args.batch, train=not args.forward)
@@ -80,6 +128,39 @@ def attribute_main(argv) -> int:
     return 0
 
 
+def _enrich_measured(result, events) -> None:
+    """Fold measured per-collective wall time into a comms result when
+    the log records a perfetto profiler capture whose trace dir still
+    exists (``POST /profile?steps=N&perfetto=1`` wrote it)."""
+    import os
+
+    from bigdl_tpu.telemetry import comms as comms_mod
+
+    captures = [e for e in events
+                if e.get("kind") == "event"
+                and e.get("name") == "profile/captured"
+                and e.get("perfetto") and e.get("dir")]
+    armed_steps = {e.get("dir"): e.get("steps")
+                   for e in events
+                   if e.get("kind") == "event"
+                   and e.get("name") == "profile/armed"}
+    for cap in reversed(captures):  # newest capture wins
+        trace_dir = cap["dir"]
+        if not os.path.isdir(trace_dir):
+            continue
+        times = comms_mod.collective_times_from_trace(trace_dir)
+        if not times:
+            continue
+        steps = max(int(armed_steps.get(trace_dir) or 1), 1)
+        # one unit everywhere: per-STEP seconds (the capture spans
+        # `steps` iterations), for the total and the per-op split alike
+        result["measured_by_op"] = {op: t / steps
+                                    for op, t in times.items()}
+        result["measured_s"] = sum(times.values()) / steps
+        result["measured_from"] = trace_dir
+        return
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "diff":
@@ -88,12 +169,17 @@ def main(argv=None) -> int:
         return diff_mod.main(argv[1:])
     if argv and argv[0] == "attribute":
         return attribute_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        from bigdl_tpu.telemetry import fleet as fleet_mod
+
+        return fleet_mod.main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="bigdl_tpu.telemetry",
         description="summarize / compare / export telemetry run logs "
-                    "(subcommands: diff <runA> <runB>, attribute "
-                    "[run.jsonl | --model NAME])")
+                    "(subcommands: diff <runA> <runB>, fleet <dir> "
+                    "[--watch], attribute [run.jsonl | --model NAME] "
+                    "[--comms])")
     p.add_argument("runs", nargs="+", metavar="run.jsonl",
                    help="path(s) to run-*.jsonl event logs; several "
                         "merge into the fleet view")
@@ -101,13 +187,13 @@ def main(argv=None) -> int:
                    help="emit the summary as JSON instead of text")
     p.add_argument("--chrome", metavar="OUT.json", default=None,
                    help="also write a Chrome trace_event JSON for "
-                        "chrome://tracing / Perfetto (single run only)")
+                        "chrome://tracing / Perfetto (several runs "
+                        "merge into one trace with a pid lane per "
+                        "process)")
     p.add_argument("--validate", action="store_true",
                    help="only validate the log(s) against the schema; "
                         "exit 1 on any violation")
     args = p.parse_args(argv)
-    if args.chrome and len(args.runs) > 1:
-        p.error("--chrome exports one run; pass a single run log")
 
     if args.validate:
         total_events = 0
@@ -138,6 +224,30 @@ def main(argv=None) -> int:
             print(json.dumps(fleet, indent=2, default=str))
         else:
             print(format_fleet(fleet))
+        if args.chrome:
+            # one merged trace, a pid lane per process — the fleet
+            # timeline view (each log keeps its own OS pid; the lane
+            # label names the process_index and file)
+            merged = []
+            names = {}
+            for path, events in loaded:
+                merged.extend(events)
+                pidx = next((e.get("meta", {}).get("process_index")
+                             for e in events
+                             if e.get("kind") == "run_start"), None)
+                for e in events:
+                    if isinstance(e.get("pid"), int):
+                        label = f"p{pidx}" if pidx is not None else "p?"
+                        names[e["pid"]] = \
+                            f"{label} ({os.path.basename(path)})"
+                        break
+            merged.sort(key=lambda e: e.get("ts", 0.0))
+            n = write_chrome_trace(merged, args.chrome,
+                                   process_names=names)
+            print(f"\nchrome trace: {args.chrome} ({n} trace events, "
+                  f"{len(loaded)} process lanes) — open in "
+                  f"chrome://tracing or https://ui.perfetto.dev",
+                  file=sys.stderr if args.json else sys.stdout)
         return 0
 
     path, events = loaded[0]

@@ -35,10 +35,16 @@ def _us(ts: float) -> float:
     return ts * 1e6
 
 
-def chrome_trace(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+def chrome_trace(events: Iterable[Dict[str, Any]],
+                 process_names: Dict[int, str] = None) -> Dict[str, Any]:
     """Build the ``{"traceEvents": [...]}`` object from parsed run
-    events."""
+    events.  ``process_names`` labels pid lanes (the multi-log fleet
+    export passes ``{os pid: "p<idx> (file)"}`` so Perfetto shows one
+    named lane per process)."""
     out: List[Dict[str, Any]] = []
+    for pid, name in (process_names or {}).items():
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "ts": 0, "args": {"name": name}})
     for ev in events:
         kind = ev.get("kind")
         pid, tid, ts = ev.get("pid", 0), ev.get("tid", 0), ev.get("ts", 0.0)
@@ -80,15 +86,17 @@ def chrome_trace(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                         "tid": tid, "ts": _us(ts), "s": "t",
                         "args": args})
         elif kind == "run_start":
-            out.append({"ph": "M", "name": "process_name", "pid": pid,
-                        "tid": tid, "ts": _us(ts),
-                        "args": {"name": "bigdl_tpu run"}})
+            if not process_names:  # explicit lane labels win
+                out.append({"ph": "M", "name": "process_name", "pid": pid,
+                            "tid": tid, "ts": _us(ts),
+                            "args": {"name": "bigdl_tpu run"}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
-def write_chrome_trace(events: Iterable[Dict[str, Any]], path: str) -> int:
+def write_chrome_trace(events: Iterable[Dict[str, Any]], path: str,
+                       process_names: Dict[int, str] = None) -> int:
     """Write the Chrome JSON; returns the number of trace events."""
-    trace = chrome_trace(events)
+    trace = chrome_trace(events, process_names=process_names)
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(trace, fh)
     return len(trace["traceEvents"])

@@ -25,7 +25,8 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-__all__ = ["peak_flops_per_device", "normalize_cost_analysis",
+__all__ = ["peak_flops_per_device", "peak_bw_per_device",
+           "normalize_cost_analysis",
            "cost_facts", "memory_facts", "live_memory_facts",
            "donated_bytes", "collect_device_facts", "mfu_estimate"]
 
@@ -57,6 +58,41 @@ def peak_flops_per_device(device_kind: str) -> Optional[float]:
     for name, peak in _PEAK_FLOPS.items():
         if kind.startswith(name.lower()):
             # longest prefix wins ("TPU v5 lite" over "TPU v5")
+            if best is None or len(name) > best[0]:
+                best = (len(name), peak)
+    return best[1] if best else None
+
+
+#: per-chip aggregate interconnect (ICI) bandwidth in bytes/s by
+#: device_kind prefix — the comms-attribution denominator
+#: (telemetry/comms.py), sibling of the peak-FLOPs table above.  These
+#: are approximate public aggregate figures; ``BIGDL_PEAK_BW`` overrides
+#: (and is the only way to describe a DCN-spanning slice, whose
+#: cross-slice links are far slower than ICI).
+_PEAK_BW = {
+    "TPU v2": 1.0e11,
+    "TPU v3": 1.4e11,
+    "TPU v4": 3.0e11,
+    "TPU v5 lite": 2.0e11,
+    "TPU v5e": 2.0e11,
+    "TPU v5p": 6.0e11,
+    "TPU v5": 6.0e11,
+    "TPU v6 lite": 3.6e11,
+    "TPU v6e": 3.6e11,
+}
+
+
+def peak_bw_per_device(device_kind: str) -> Optional[float]:
+    """Aggregate interconnect bytes/s for one device, or None when
+    unknown (CPU collectives have no meaningful peak).  ``BIGDL_PEAK_BW``
+    (bytes/s) overrides the table — also the DCN escape hatch."""
+    env = os.environ.get("BIGDL_PEAK_BW")
+    if env:
+        return float(env)
+    kind = (device_kind or "").lower()
+    best = None
+    for name, peak in _PEAK_BW.items():
+        if kind.startswith(name.lower()):
             if best is None or len(name) > best[0]:
                 best = (len(name), peak)
     return best[1] if best else None
@@ -167,6 +203,9 @@ def collect_device_facts(lowered, donated_trees=(), level: str = "auto"
         peak = peak_flops_per_device(dev.device_kind)
         if peak:
             facts["peak_flops_per_device"] = peak
+        peak_bw = peak_bw_per_device(dev.device_kind)
+        if peak_bw:
+            facts["peak_bw_per_device"] = peak_bw
     except Exception:  # noqa: BLE001
         pass
     if level == "full":

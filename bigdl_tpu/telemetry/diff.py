@@ -58,6 +58,14 @@ _RULES: List[Tuple[str, str, str]] = [
     ("retraces", "lower", "count"),
     ("health_events", "lower", "count"),
     ("nonfinite_steps", "lower", "count"),
+    # comms metrics (telemetry/comms.py): collective bytes per step and
+    # collective seconds per step — the ZeRO/pipeline bytes-moved gate
+    # ("did this sharding change move more data than it saved?"),
+    # pct-thresholded like MFU
+    ("comms_bytes", "lower", "pct"),
+    ("comms_s", "lower", "pct"),
+    (".comms_bytes", "lower", "pct"),
+    (".comms_s", "lower", "pct"),
     (".images_per_sec", "higher", "pct"),
     (".mfu", "higher", "pct"),
     # serving metrics (bigdl_tpu/serving + bench_serving.py): latency
@@ -120,6 +128,21 @@ def run_log_metrics(path: str) -> Dict[str, Any]:
     out["compile_s"] = sum(float(c.get("dur", 0.0))
                            for c in summary["compiles"])
     out["retraces"] = len(summary["retraces"])
+    # comms snapshot (telemetry/comms.py, kind "comms"): the LAST event
+    # describes the step program that ran — bytes are exact at trace
+    # time; seconds prefer a measured profiler capture over the
+    # peak-bandwidth expectation
+    comms_events = [e for e in events if e.get("kind") == "comms"]
+    if comms_events:
+        last = comms_events[-1]
+        if last.get("bytes") is not None:
+            out["comms_bytes"] = float(last["bytes"])
+        measured = [e for e in comms_events
+                    if e.get("measured_s") is not None]
+        if measured:
+            out["comms_s"] = float(measured[-1]["measured_s"])
+        elif last.get("expected_s") is not None:
+            out["comms_s"] = float(last["expected_s"])
     health = summary.get("health", {})
     out["health_events"] = sum(health.get("events", {}).values())
     out["nonfinite_steps"] = health.get("nonfinite_steps", 0)
@@ -161,6 +184,11 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
         # slack steady-state counters
         for key in ("p50_ms", "p99_ms", "qps", "rejected",
                     "steady_compiles", "retrace_diagnostics"):
+            if row.get(key) is not None:
+                out[f"{name}.{key}"] = float(row[key])
+        # comms snapshot on bench rows (bench.py reads it off the scan
+        # executable) — lets ZeRO/pipeline PRs gate on bytes moved
+        for key in ("comms_bytes", "comms_s"):
             if row.get(key) is not None:
                 out[f"{name}.{key}"] = float(row[key])
     if doc.get("value") is not None and not doc.get("configs"):

@@ -1,0 +1,651 @@
+"""Live cross-host fleet aggregation: tail every per-process run log,
+keep a rolling per-host table, and blame step skew on the component that
+actually caused it.
+
+PRs 2-4 built the observability stack for ONE process; a multi-host job
+writes one ``run-<stamp>-p<idx>-<pid>.jsonl`` per process and until now
+the only cross-host view was an after-the-fact merge
+(``report.fleet_summarize``).  This module makes the fleet view *live*
+and *diagnostic*:
+
+- :class:`FleetWatcher` — a coordinator-side daemon thread (same
+  shared-directory pattern as the PR-7 heartbeat mesh: it works wherever
+  the run logs do, local disk or NFS) that tails every ``run-*.jsonl``
+  under the telemetry dir incrementally and folds new events into
+  per-host rolling state.  Surfaced as a ``fleet`` block on ``/status``,
+  ``bigdl_fleet_*`` gauges on ``/metrics``, ``fleet/lag_steps`` /
+  ``fleet/skew_s`` gauges in the coordinator's own run log, and
+  ``cluster/skew`` instants when the fleet diverges — which the PR-7
+  collective watchdog's flight dump then carries as evidence.
+
+- **Step-skew blame**: when one host falls behind (or the fleet runs in
+  SPMD lock-step but one host drags every step), the gap is attributed
+  from each host's OWN spans: ``data_wait`` (input stall), ``checkpoint``
+  (save stall), comms (measured collective seconds from ``comms``
+  events), and compute (the residual).  The verdict prefers the
+  *attributable* components: on a synchronous step, a straggler's excess
+  shows up on every OTHER host as collective wait inside compute — the
+  Blink observation — so a host with genuine data-wait excess is named
+  the culprit even though everyone's step time degraded equally.
+  Compute is blamed only when no attributable component explains the
+  gap.
+
+- :func:`fleet_view` — the one-shot merge (``python -m
+  bigdl_tpu.telemetry fleet <dir>`` and the multi-log positional CLI
+  both land here; ``report.fleet_summarize`` delegates).  Re-incarnation
+  logs (a PR-7 supervisor restart writes a second log for the same
+  rank) are MERGED by taking the latest run per ``process_index``
+  rather than double-counting skew across incarnations; superseded
+  paths are reported, not warned about.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["HostState", "FleetWatcher", "fleet_view", "blame",
+           "format_fleet_view", "fleet_openmetrics", "main",
+           "WINDOW_STEPS", "SKEW_LAG_STEPS", "SKEW_MIN_EXCESS_S",
+           "SKEW_REL_EXCESS"]
+
+#: rolling window of steps kept per host — the table describes the
+#: recent past, not the whole run (a warmup hiccup must age out)
+WINDOW_STEPS = 64
+#: completed-step gap that alone counts as divergence
+SKEW_LAG_STEPS = 3
+#: a component excess must clear BOTH floors to be blamed: an absolute
+#: seconds floor and a fraction of the fleet's best step time
+SKEW_MIN_EXCESS_S = 0.02
+SKEW_REL_EXCESS = 0.2
+
+#: blame components read from each host's own spans; compute is the
+#: residual and deliberately last — on a synchronous step every healthy
+#: host's compute inflates with the straggler's excess (collective
+#: wait), so compute excess on ONE host is a symptom unless nothing
+#: attributable explains the gap
+ATTRIBUTABLE = ("data_wait", "comms", "checkpoint")
+
+
+class HostState:
+    """Rolling per-host state folded from one run log's events."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.process_index: Optional[int] = None
+        self.run_ts: Optional[float] = None   # run_start ts = run id
+        self.meta: Dict[str, Any] = {}
+        self.n_steps = 0
+        self.last_step = 0
+        self.last_step_ts: Optional[float] = None
+        self.first_ts: Optional[float] = None
+        self.last_ts: Optional[float] = None
+        self.ended = False
+        self.nonfinite_steps = 0
+        self.ckpt_step: Optional[int] = None
+        self.ckpt_ts: Optional[float] = None
+        self.comms_s_per_step = 0.0   # latest comms event's seconds
+        self.comms_bytes = 0
+        # (step, ts, dur, components) rows, newest last
+        self.window: deque = deque(maxlen=WINDOW_STEPS)
+        self._pending: Dict[str, float] = {}
+
+    # -- folding -------------------------------------------------------------
+    def fold(self, events: List[Dict[str, Any]]) -> None:
+        for ev in events:
+            kind = ev.get("kind")
+            ts = ev.get("ts")
+            if isinstance(ts, (int, float)):
+                self.first_ts = ts if self.first_ts is None \
+                    else min(self.first_ts, ts)
+                self.last_ts = ts if self.last_ts is None \
+                    else max(self.last_ts, ts)
+            if kind == "run_start":
+                self.meta.update(ev.get("meta") or {})
+                if self.run_ts is None and isinstance(ts, (int, float)):
+                    self.run_ts = ts
+                if self.process_index is None:
+                    pidx = self.meta.get("process_index")
+                    if isinstance(pidx, int):
+                        self.process_index = pidx
+            elif kind == "span_end":
+                # blame components read from the host's own spans;
+                # validation deliberately rides the compute residual
+                name = ev.get("name")
+                if name in ("data_wait", "checkpoint"):
+                    self._pending[name] = self._pending.get(name, 0.0) \
+                        + float(ev.get("dur", 0.0))
+            elif kind == "step":
+                step = ev.get("step")
+                dur = float(ev.get("dur", 0.0))
+                if isinstance(step, int):
+                    self.n_steps += 1
+                    self.last_step = max(self.last_step, step)
+                    self.last_step_ts = ts if isinstance(ts, (int, float)) \
+                        else self.last_step_ts
+                    comp = dict(self._pending)
+                    comp["comms"] = self.comms_s_per_step
+                    self.window.append((step, ts, dur, comp))
+                    self._pending = {}
+            elif kind == "health":
+                if ev.get("nonfinite_grads") or ev.get("nonfinite_params"):
+                    self.nonfinite_steps += 1
+            elif kind == "comms":
+                self.comms_bytes = int(ev.get("bytes", 0) or 0)
+                s = ev.get("measured_s")
+                if s is None:
+                    s = ev.get("expected_s")
+                self.comms_s_per_step = float(s or 0.0)
+            elif kind == "event":
+                if ev.get("name") == "checkpoint/saved":
+                    self.ckpt_step = ev.get("step")
+                    self.ckpt_ts = ts if isinstance(ts, (int, float)) \
+                        else self.ckpt_ts
+            elif kind == "run_end":
+                self.ended = True
+
+    # -- derived -------------------------------------------------------------
+    def _percentile(self, q: float) -> float:
+        durs = sorted(d for _, _, d, _ in self.window)
+        if not durs:
+            return 0.0
+        idx = min(len(durs) - 1,
+                  max(0, int(round(q / 100.0 * (len(durs) - 1)))))
+        return durs[idx]
+
+    def components(self) -> Dict[str, float]:
+        """Mean per-step seconds per blame component over the window
+        (compute = residual, floored at 0)."""
+        n = len(self.window)
+        if n == 0:
+            return {c: 0.0 for c in ATTRIBUTABLE + ("compute",)}
+        totals: Dict[str, float] = {c: 0.0 for c in ATTRIBUTABLE}
+        dur_total = 0.0
+        for _, _, dur, comp in self.window:
+            dur_total += dur
+            for c in ATTRIBUTABLE:
+                totals[c] += float(comp.get(c, 0.0))
+        out = {c: totals[c] / n for c in ATTRIBUTABLE}
+        out["compute"] = max(dur_total / n - sum(out.values()), 0.0)
+        return out
+
+    def row(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = time.time() if now is None else now
+        comp = self.components()
+        p50 = self._percentile(50)
+        shares = {f"{c}_share": (comp[c] / p50 if p50 else 0.0)
+                  for c in ("data_wait", "comms", "checkpoint", "compute")}
+        return {"path": self.path,
+                "process_index": self.process_index,
+                "last_step": self.last_step,
+                "age_s": (round(now - self.last_step_ts, 3)
+                          if self.last_step_ts else None),
+                "steps": self.n_steps,
+                "p50_s": p50, "p95_s": self._percentile(95),
+                "wall_s": ((self.last_ts - self.first_ts)
+                           if self.first_ts is not None
+                           and self.last_ts is not None else 0.0),
+                "components_s": comp, **shares,
+                "comms_bytes": self.comms_bytes,
+                "nonfinite_steps": self.nonfinite_steps,
+                "checkpoint_step": self.ckpt_step,
+                "checkpoint_age_s": (round(now - self.ckpt_ts, 3)
+                                     if self.ckpt_ts else None),
+                "ended": self.ended}
+
+
+# -- skew blame ---------------------------------------------------------------
+def blame(hosts: List[HostState]) -> Optional[Dict[str, Any]]:
+    """Name the host dragging the fleet and the component at fault.
+
+    Baseline per component = the fleet MINIMUM (the best host shows what
+    the step costs without the problem).  Attributable components
+    (data-wait, comms, checkpoint) are judged first; compute residual
+    only when nothing attributable clears the significance floor — on a
+    synchronous step, every healthy host's compute carries the
+    straggler's excess as collective wait, so compute excess alone
+    cannot localize the culprit.  Returns None with fewer than two
+    hosts carrying steps, or when nothing clears the floor and the
+    fleet is in lock-step."""
+    active = [h for h in hosts if h.window]
+    if len(active) < 2:
+        return None
+    comp = {h: h.components() for h in active}
+    p50s = [h._percentile(50) for h in active]
+    floor = max(SKEW_MIN_EXCESS_S, SKEW_REL_EXCESS * min(p50s))
+    base = {c: min(comp[h][c] for h in active)
+            for c in ATTRIBUTABLE + ("compute",)}
+
+    def verdict(h: HostState, cause: str, excess: float) -> Dict[str, Any]:
+        last_steps = [x.last_step for x in active]
+        return {"laggard": h.process_index, "cause": cause,
+                "excess_s": round(excess, 6),
+                "lag_steps": max(last_steps) - min(last_steps),
+                "floor_s": round(floor, 6),
+                "components": {f"p{x.process_index}":
+                               {k: round(v, 6)
+                                for k, v in comp[x].items()}
+                               for x in active}}
+
+    best: Optional[Tuple[HostState, str, float]] = None
+    for h in active:
+        for c in ATTRIBUTABLE:
+            excess = comp[h][c] - base[c]
+            if excess > floor and (best is None or excess > best[2]):
+                best = (h, c, excess)
+    if best is not None:
+        return verdict(*best)
+    for h in active:
+        excess = comp[h]["compute"] - base["compute"]
+        if excess > floor and (best is None or excess > best[2]):
+            best = (h, "compute", excess)
+    if best is not None:
+        return verdict(*best)
+    # no per-step component gap: a host that stopped stepping entirely
+    # (crash/wedge) still lags — blame by progress
+    last_steps = [h.last_step for h in active]
+    if max(last_steps) - min(last_steps) >= SKEW_LAG_STEPS:
+        laggard = min(active, key=lambda h: h.last_step)
+        return verdict(laggard, "stalled",
+                       float(max(last_steps) - laggard.last_step))
+    return None
+
+
+# -- one-shot merge (absorbs report.fleet_summarize) --------------------------
+def _dedupe_latest(states: List[HostState]
+                   ) -> Tuple[List[HostState], List[str], List[str]]:
+    """Keep one log per process_index — the latest run (by run_start
+    ts, path as tiebreak).  A supervisor restart writes a fresh log for
+    every rank; skew across incarnations is meaningless, so older
+    incarnations are superseded, not double-counted.  Logs with no
+    process_index stay (each its own row).  Returns (kept, superseded
+    paths, notes)."""
+    by_pidx: Dict[int, List[HostState]] = {}
+    kept: List[HostState] = []
+    superseded: List[str] = []
+    notes: List[str] = []
+    for st in states:
+        if isinstance(st.process_index, int):
+            by_pidx.setdefault(st.process_index, []).append(st)
+        else:
+            kept.append(st)
+    for pidx, group in sorted(by_pidx.items()):
+        group.sort(key=lambda s: (s.run_ts or s.first_ts or 0.0, s.path))
+        kept.append(group[-1])
+        for old in group[:-1]:
+            superseded.append(old.path)
+        if len(group) > 1:
+            notes.append(
+                f"process {pidx}: kept latest of {len(group)} logs "
+                f"({os.path.basename(group[-1].path)}); superseded "
+                f"{[os.path.basename(o.path) for o in group[:-1]]}")
+    kept.sort(key=lambda s: (s.process_index is None,
+                             s.process_index or 0, s.path))
+    return kept, superseded, notes
+
+
+def fleet_view(runs: List[Tuple[str, List[Dict[str, Any]]]],
+               now: Optional[float] = None) -> Dict[str, Any]:
+    """Merge per-process run logs into one fleet view: the rich rolling
+    rows + blame verdict, plus the legacy ``processes``/``step_lag``/
+    ``skew`` surface ``report.fleet_summarize`` promised."""
+    states: List[HostState] = []
+    for path, events in runs:
+        st = HostState(path)
+        st.fold(events)
+        states.append(st)
+    kept, superseded, notes = _dedupe_latest(states)
+    # legacy cross-host step-completion skew over the kept logs
+    step_ts: Dict[int, Dict[int, float]] = {}
+    for st in kept:
+        if st.process_index is None:
+            continue
+        for step, ts, _dur, _c in st.window:
+            if isinstance(ts, (int, float)):
+                step_ts.setdefault(step, {})[st.process_index] = ts
+    skew: Dict[str, Any] = {"max_s": 0.0, "at_step": None, "mean_s": 0.0}
+    spreads = []
+    for step, by_proc in step_ts.items():
+        if len(by_proc) < 2:
+            continue
+        spread = max(by_proc.values()) - min(by_proc.values())
+        spreads.append(spread)
+        if spread > skew["max_s"]:
+            skew["max_s"], skew["at_step"] = spread, step
+    if spreads:
+        skew["mean_s"] = sum(spreads) / len(spreads)
+    last_steps = [st.last_step for st in kept]
+    rows = [st.row(now) for st in kept]
+    # legacy per-process rows (fleet_summarize's exact field set)
+    processes = []
+    for i, st in enumerate(kept):
+        pidx = st.process_index if st.process_index is not None \
+            else -(i + 1)
+        processes.append({"path": st.path, "process_index": pidx,
+                          "steps": st.n_steps,
+                          "last_step": st.last_step,
+                          "p50_s": st._percentile(50),
+                          "p95_s": st._percentile(95),
+                          "wall_s": rows[i]["wall_s"],
+                          "nonfinite_steps": st.nonfinite_steps})
+    return {"hosts": {f"p{p['process_index']}": r
+                      for p, r in zip(processes, rows)},
+            "processes": processes,
+            "step_lag": (max(last_steps) - min(last_steps))
+            if last_steps else 0,
+            "skew": skew,
+            "blame": blame(kept),
+            "superseded": superseded,
+            "notes": notes,
+            "warnings": []}
+
+
+def discover_logs(target: str) -> List[str]:
+    """Run logs under ``target``: a directory globs ``run-*.jsonl``
+    (recursively one level is enough — runs write flat), a file is
+    itself."""
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "run-*.jsonl")))
+    return [target]
+
+
+# -- rendering ---------------------------------------------------------------
+def _pct(x: float) -> str:
+    return f"{x * 100:4.0f}%"
+
+
+def format_fleet_view(view: Dict[str, Any]) -> str:
+    hosts = view.get("processes") or []
+    lines = [f"== fleet view ({len(hosts)} processes) =="]
+    for w in view.get("warnings", []):
+        lines.append(f"WARNING: {w}")
+    for note in view.get("notes", []):
+        lines.append(f"note: {note}")
+    rich = view.get("hosts") or {}
+    for p in sorted(hosts, key=lambda r: r["process_index"]):
+        r = rich.get(f"p{p['process_index']}", {})
+        age = r.get("age_s")
+        lines.append(
+            f"p{p['process_index']:<3} step {p['last_step']:<6} "
+            f"age {age if age is not None else '?':>7}s  "
+            f"p50 {p['p50_s'] * 1e3:8.2f} ms  "
+            f"data {_pct(r.get('data_wait_share', 0.0))}  "
+            f"comms {_pct(r.get('comms_share', 0.0))}  "
+            f"ckpt {_pct(r.get('checkpoint_share', 0.0))}  "
+            f"nonfinite {p['nonfinite_steps']}"
+            f"{'  ENDED' if r.get('ended') else ''}  ({p['path']})")
+    lines.append(f"step lag (fastest - slowest last step): "
+                 f"{view['step_lag']}")
+    skew = view["skew"]
+    if skew["at_step"] is not None:
+        lines.append(f"step skew: max {skew['max_s'] * 1e3:.2f} ms at "
+                     f"step {skew['at_step']}, mean "
+                     f"{skew['mean_s'] * 1e3:.2f} ms")
+    else:
+        lines.append("step skew: n/a (no step index seen by >1 process)")
+    verdict = view.get("blame")
+    if verdict:
+        lines.append(
+            f"skew blame: p{verdict['laggard']} — {verdict['cause']} "
+            f"(+{verdict['excess_s'] * 1e3:.1f} ms/step over the best "
+            f"host, floor {verdict['floor_s'] * 1e3:.1f} ms)")
+    else:
+        lines.append("skew blame: none (fleet healthy or <2 active hosts)")
+    return "\n".join(lines)
+
+
+# -- the live watcher ---------------------------------------------------------
+class _Tail:
+    """Incremental JSONL reader: remembers the byte offset, keeps a
+    partial trailing line until its newline lands."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.pos = 0
+        self._buf = ""
+
+    def read_new(self) -> List[Dict[str, Any]]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                fh.seek(self.pos)
+                chunk = fh.read()
+                self.pos = fh.tell()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        text = self._buf + chunk
+        lines = text.split("\n")
+        self._buf = lines.pop()  # partial (or empty) tail
+        events = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                pass  # torn write mid-line: the next read won't heal a
+                # complete-but-bad line, so just skip it
+        return events
+
+
+class FleetWatcher:
+    """Coordinator-side live aggregator over a telemetry directory.
+
+    Started by ``telemetry.start_run`` on the coordinator of a
+    multi-process run (``BIGDL_FLEET_INTERVAL`` > 0); every poll it
+    discovers/tails ``run-*.jsonl`` files, folds new events, and
+    publishes: ``snapshot()`` (the /status block), ``fleet/lag_steps``
+    + ``fleet/skew_s`` gauges and ``cluster/skew`` instants into the
+    active tracer (rate-limited, and only on a meaningful change)."""
+
+    #: min seconds between cluster/skew instants for the SAME verdict
+    SKEW_COOLDOWN_S = 20.0
+
+    def __init__(self, directory: str, interval: float = 2.0):
+        self.directory = directory
+        self.interval = max(float(interval), 0.2)
+        self._tails: Dict[str, _Tail] = {}
+        self._states: Dict[str, HostState] = {}
+        self._lock = threading.Lock()
+        # serializes whole polls: end_run's final poll_once and the
+        # daemon thread's scheduled one must not interleave on the same
+        # _Tail offsets (a shared read would fold every event twice)
+        self._poll_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_emit: Dict[str, Any] = {}
+        self._last_skew_at = 0.0
+
+    def start(self) -> "FleetWatcher":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="bigdl-fleet-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4 + 1.0)
+
+    # -- polling -------------------------------------------------------------
+    def poll_once(self) -> None:
+        """One discovery+fold pass (the loop body; tests call it
+        directly for determinism).  Polls are serialized — concurrent
+        callers (end_run's final poll vs the daemon thread) wait."""
+        with self._poll_lock:
+            for path in discover_logs(self.directory):
+                if path not in self._tails:
+                    self._tails[path] = _Tail(path)
+                    self._states[path] = HostState(path)
+                events = self._tails[path].read_new()
+                if events:
+                    with self._lock:
+                        self._states[path].fold(events)
+            self._publish()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - an observer never kills
+                pass  # the run (transient fs errors on shared dirs)
+
+    # -- views ---------------------------------------------------------------
+    def _kept(self) -> List[HostState]:
+        with self._lock:
+            states = list(self._states.values())
+        kept, _sup, _notes = _dedupe_latest(states)
+        return kept
+
+    def snapshot(self) -> Dict[str, Any]:
+        kept = self._kept()
+        now = time.time()
+        last_steps = [h.last_step for h in kept if h.window]
+        return {"dir": self.directory,
+                "files": len(self._tails),
+                "hosts": {f"p{h.process_index}"
+                          if h.process_index is not None
+                          else f"?{i}": h.row(now)
+                          for i, h in enumerate(kept)},
+                "lag_steps": (max(last_steps) - min(last_steps))
+                if last_steps else 0,
+                "blame": blame(kept)}
+
+    # -- publishing ----------------------------------------------------------
+    def _publish(self) -> None:
+        from bigdl_tpu import telemetry
+
+        if not telemetry.enabled():
+            return
+        kept = self._kept()
+        active = [h for h in kept if h.window]
+        last_steps = [h.last_step for h in active]
+        lag = (max(last_steps) - min(last_steps)) if last_steps else 0
+        verdict = blame(kept)
+        skew_s = float(verdict["excess_s"]) if verdict else 0.0
+        if lag != self._last_emit.get("lag"):
+            telemetry.gauge("fleet/lag_steps", lag)
+            self._last_emit["lag"] = lag
+        prev_skew = self._last_emit.get("skew_s")
+        if prev_skew is None or abs(skew_s - prev_skew) \
+                > 0.1 * max(prev_skew, 1e-9):
+            telemetry.gauge("fleet/skew_s", skew_s)
+            self._last_emit["skew_s"] = skew_s
+        if verdict is None:
+            self._last_emit.pop("verdict", None)
+            return
+        key = (verdict["laggard"], verdict["cause"])
+        now = time.time()
+        if key != self._last_emit.get("verdict") \
+                or now - self._last_skew_at > self.SKEW_COOLDOWN_S:
+            telemetry.instant("cluster/skew", laggard=verdict["laggard"],
+                              cause=verdict["cause"],
+                              excess_s=verdict["excess_s"],
+                              lag_steps=verdict["lag_steps"],
+                              hosts=len(active))
+            self._last_emit["verdict"] = key
+            self._last_skew_at = now
+
+
+def fleet_openmetrics() -> List[str]:
+    """``bigdl_fleet_*`` exposition lines for the /metrics endpoint
+    (empty when no watcher is live — non-coordinators and single-process
+    runs export nothing)."""
+    from bigdl_tpu import telemetry
+
+    watcher = telemetry.fleet_watcher()
+    if watcher is None:
+        return []
+    snap = watcher.snapshot()
+    lines = ["# HELP bigdl_fleet_hosts run logs the fleet watcher tails",
+             "# TYPE bigdl_fleet_hosts gauge",
+             f"bigdl_fleet_hosts {len(snap['hosts'])}",
+             "# HELP bigdl_fleet_lag_steps fastest minus slowest host "
+             "last step",
+             "# TYPE bigdl_fleet_lag_steps gauge",
+             f"bigdl_fleet_lag_steps {snap['lag_steps']}"]
+    verdict = snap.get("blame")
+    lines += ["# HELP bigdl_fleet_skew_seconds blamed per-step excess of "
+              "the laggard host",
+              "# TYPE bigdl_fleet_skew_seconds gauge",
+              f"bigdl_fleet_skew_seconds "
+              f"{verdict['excess_s'] if verdict else 0}"]
+    per_host = [("bigdl_fleet_last_step", "last_step",
+                 "latest completed step per host"),
+                ("bigdl_fleet_step_p50_seconds", "p50_s",
+                 "rolling p50 step seconds per host"),
+                ("bigdl_fleet_data_wait_share", "data_wait_share",
+                 "data-wait share of step time per host"),
+                ("bigdl_fleet_comms_share", "comms_share",
+                 "comms share of step time per host")]
+    for metric, field, help_ in per_host:
+        lines.append(f"# HELP {metric} {help_}")
+        lines.append(f"# TYPE {metric} gauge")
+        for name, row in sorted(snap["hosts"].items()):
+            pidx = row.get("process_index")
+            if pidx is None:
+                continue
+            val = row.get(field)
+            if val is None:
+                continue
+            lines.append(f'{metric}{{process_index="{pidx}"}} '
+                         f"{float(val):g}")
+    return lines
+
+
+# -- CLI ----------------------------------------------------------------------
+def main(argv=None) -> int:
+    """``python -m bigdl_tpu.telemetry fleet <dir-or-logs> [--watch]``."""
+    import argparse
+    import sys
+
+    from bigdl_tpu.telemetry import schema
+
+    p = argparse.ArgumentParser(
+        prog="bigdl_tpu.telemetry fleet",
+        description="live/one-shot cross-host fleet table with step-skew "
+                    "blame over per-process run logs")
+    p.add_argument("targets", nargs="+", metavar="DIR|run.jsonl",
+                   help="telemetry dir (globs run-*.jsonl) or explicit "
+                        "run logs")
+    p.add_argument("--watch", action="store_true",
+                   help="redraw every --interval seconds until ^C")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    def load() -> List[Tuple[str, List[Dict[str, Any]]]]:
+        paths: List[str] = []
+        for t in args.targets:
+            paths.extend(discover_logs(t))
+        loaded = []
+        for path in paths:
+            events, _errs = schema.read_events(path)
+            loaded.append((path, events))
+        return loaded
+
+    while True:
+        loaded = load()
+        if not loaded:
+            print(f"error: no run-*.jsonl under {args.targets}",
+                  file=sys.stderr)
+            return 2
+        view = fleet_view(loaded)
+        if args.json:
+            print(json.dumps(view, indent=2, default=str))
+        else:
+            print(format_fleet_view(view))
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(max(args.interval, 0.2))
+            print()
+        except KeyboardInterrupt:
+            return 0

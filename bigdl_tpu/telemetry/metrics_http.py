@@ -81,6 +81,9 @@ class MetricsSink:
         self.serve_batches = 0
         self.serve_rows = 0
         self.last_serve: Dict[str, Any] = {}
+        # per-collective comms attribution (kind "comms",
+        # telemetry/comms.py): the latest per-step snapshot
+        self.last_comms: Dict[str, Any] = {}
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -139,6 +142,12 @@ class MetricsSink:
                 self.last_serve = {k: event[k] for k in
                                    ("size", "queue_ms", "infer_ms",
                                     "fill") if k in event}
+            elif kind == "comms":
+                self.last_comms = {k: event[k] for k in
+                                   ("count", "bytes", "payload_bytes",
+                                    "by_axis", "expected_s",
+                                    "measured_s", "program")
+                                   if k in event}
 
     def flush(self) -> None:
         pass
@@ -173,7 +182,8 @@ class MetricsSink:
                     "preempted": self.preempted,
                     "serve_batches": self.serve_batches,
                     "serve_rows": self.serve_rows,
-                    "last_serve": dict(self.last_serve)}
+                    "last_serve": dict(self.last_serve),
+                    "comms": dict(self.last_comms)}
 
     def openmetrics(self) -> str:
         """Prometheus/OpenMetrics exposition text of the current state."""
@@ -245,6 +255,13 @@ class MetricsSink:
                    "persistent compile cache misses (this run)")
             sample("bigdl_retraces_total", "counter", self.retraces,
                    "retrace attributions observed")
+            if self.last_comms:
+                sample("bigdl_comms_bytes_per_step", "gauge",
+                       self.last_comms.get("bytes"),
+                       "collective bytes accessed per compiled step")
+                sample("bigdl_comms_collectives", "gauge",
+                       self.last_comms.get("count"),
+                       "collective op count per compiled step")
             for name, count in sorted(self.events.items()):
                 sample(_metric_name(name, "bigdl_event_") + "_total",
                        "counter", count, f"instant events named {name}")
@@ -254,6 +271,14 @@ class MetricsSink:
             for name, value in sorted(self.gauges.items()):
                 sample(_metric_name(name), "gauge", value,
                        f"telemetry gauge {name}")
+            # live fleet gauges (telemetry/fleet.py; coordinator only —
+            # elsewhere the watcher is None and nothing is exported)
+            try:
+                from bigdl_tpu.telemetry.fleet import fleet_openmetrics
+
+                lines.extend(fleet_openmetrics())
+            except Exception:  # noqa: BLE001 - observers never fail
+                pass  # the scrape
             lines.append("# EOF")
             return "\n".join(lines) + "\n"
 
@@ -293,6 +318,16 @@ def _observer_status() -> Dict[str, Any]:
             # the per-peer heartbeat table (step, age, status, lost
             # reason) — docs/fault_tolerance.md "Distributed failures"
             out["cluster"] = cl.status()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from bigdl_tpu import telemetry
+
+        fw = telemetry.fleet_watcher()
+        if fw is not None:
+            # the live cross-host table + skew blame — coordinator only
+            # (telemetry/fleet.py); tpu_watch prints the one-line form
+            out["fleet"] = fw.snapshot()
     except Exception:  # noqa: BLE001
         pass
     try:
@@ -387,7 +422,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(400, (body + "\n").encode("utf-8"),
                               "application/json")
                 return
-            armed = control.arm(steps, trace_dir, source="http")
+            # perfetto=1: also write the Chrome/Perfetto JSON trace —
+            # the artifact telemetry/comms.py reads per-collective wall
+            # time from (docs/observability.md "Is my all-reduce the
+            # bottleneck?")
+            perfetto = (query.get("perfetto", ["0"])[0].lower()
+                        in ("1", "true", "yes", "on"))
+            armed = control.arm(steps, trace_dir, source="http",
+                                perfetto=perfetto)
             payload = {"armed": armed, **control.status()}
             if not armed:
                 payload["error"] = "a capture is already armed or running"

@@ -43,15 +43,18 @@ class ProfilerControl:
         self.steps_left = 0
         self.trace_dir: Optional[str] = None
         self.source: Optional[str] = None
+        self.perfetto = False
         self.last_trace_dir: Optional[str] = None
         self.captures = 0
         self.last_error: Optional[str] = None
 
     def arm(self, steps: int, trace_dir: str,
-            source: str = "api") -> bool:
+            source: str = "api", perfetto: bool = False) -> bool:
         """Request a capture of the next ``steps`` iterations.  Returns
         False (without queueing) when a capture is already armed or in
-        flight."""
+        flight.  ``perfetto=True`` additionally writes the
+        Chrome/Perfetto JSON trace — the per-collective wall-time
+        artifact ``telemetry/comms.py`` parses."""
         if steps < 1 or not trace_dir:
             return False
         with self._lock:
@@ -61,10 +64,12 @@ class ProfilerControl:
             self.steps_left = int(steps)
             self.trace_dir = trace_dir
             self.source = source
+            self.perfetto = bool(perfetto)
         from bigdl_tpu import telemetry
 
         telemetry.instant("profile/armed", steps=int(steps),
-                          dir=trace_dir, source=source)
+                          dir=trace_dir, source=source,
+                          perfetto=bool(perfetto))
         return True
 
     def poll_begin(self) -> None:
@@ -79,7 +84,14 @@ class ProfilerControl:
                 import jax
 
                 os.makedirs(self.trace_dir, exist_ok=True)
-                jax.profiler.start_trace(self.trace_dir)
+                if self.perfetto:
+                    try:
+                        jax.profiler.start_trace(
+                            self.trace_dir, create_perfetto_trace=True)
+                    except TypeError:  # older jax: no perfetto kwarg
+                        jax.profiler.start_trace(self.trace_dir)
+                else:
+                    jax.profiler.start_trace(self.trace_dir)
                 self.state = CAPTURING
             except Exception as e:  # noqa: BLE001 - observer, never fatal
                 self.last_error = f"{type(e).__name__}: {e}"
@@ -132,9 +144,10 @@ class ProfilerControl:
             self.steps_left = 0
             self.trace_dir = None
             self.source = None
+            perfetto, self.perfetto = self.perfetto, False
         if ok:  # a failed stop wrote no trace: don't announce one
             telemetry.instant("profile/captured", dir=trace_dir,
-                              source=source or "api")
+                              source=source or "api", perfetto=perfetto)
 
     def status(self) -> Dict[str, Any]:
         with self._lock:

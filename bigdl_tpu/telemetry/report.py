@@ -288,84 +288,18 @@ def format_summary(summary: Dict[str, Any],
 
 # -- fleet view (multi-host) -------------------------------------------------
 def fleet_summarize(runs: List[tuple]) -> Dict[str, Any]:
-    """Merge per-process run logs into one fleet view.
+    """Merge per-process run logs into one fleet view — a thin delegate
+    to :func:`bigdl_tpu.telemetry.fleet.fleet_view`, which owns the
+    cross-host story (rolling per-host table, step-skew, blame verdict,
+    re-incarnation merge by latest run per ``process_index``).  Kept
+    here for the original import surface; the legacy ``processes`` /
+    ``step_lag`` / ``skew`` keys are unchanged."""
+    from bigdl_tpu.telemetry.fleet import fleet_view
 
-    ``runs``: list of ``(path, events)`` pairs, one per process (the
-    per-``process_index`` JSONL logs a multi-host job writes).  Computes
-    per-process step progress plus step-skew: for every step index seen
-    by more than one process, the spread between the earliest and latest
-    completion timestamp — a persistently large spread names the
-    straggler before it trips the watchdog."""
-    procs: List[Dict[str, Any]] = []
-    step_ts: Dict[int, Dict[int, float]] = {}  # step -> {pidx: ts}
-    warnings: List[str] = []
-    seen_pidx: set = set()
-    for path, events in runs:
-        summary = summarize(events)
-        pidx = summary["meta"].get("process_index")
-        if pidx is None or pidx in seen_pidx:
-            if pidx in seen_pidx:
-                # two logs claiming one process = logs from DIFFERENT
-                # jobs mixed into one fleet (stale glob match): keep
-                # both visible, but skew across them is meaningless
-                warnings.append(
-                    f"duplicate process_index {pidx} ({path}): logs "
-                    f"from different runs mixed? step-skew excludes it")
-            # negative sentinel: can never collide with a real (>= 0)
-            # process_index carried by another log in the set
-            pidx = -(len(procs) + 1)
-        seen_pidx.add(pidx)
-        st = summary["steps"]
-        last_step = 0
-        for ev in events:
-            if ev.get("kind") == "step" and isinstance(ev.get("step"), int):
-                last_step = max(last_step, ev["step"])
-                ts = ev.get("ts")
-                # sentinel (unidentified/duplicate) processes stay out
-                # of the skew math — pairing them is meaningless
-                if isinstance(ts, (int, float)) and pidx >= 0:
-                    step_ts.setdefault(ev["step"], {})[pidx] = ts
-        procs.append({"path": path, "process_index": pidx,
-                      "steps": st["count"], "last_step": last_step,
-                      "p50_s": st["p50_s"], "p95_s": st["p95_s"],
-                      "wall_s": summary["wall_s"],
-                      "nonfinite_steps":
-                          summary["health"]["nonfinite_steps"]})
-    skew: Dict[str, Any] = {"max_s": 0.0, "at_step": None, "mean_s": 0.0}
-    spreads = []
-    for step, by_proc in step_ts.items():
-        if len(by_proc) < 2:
-            continue
-        spread = max(by_proc.values()) - min(by_proc.values())
-        spreads.append(spread)
-        if spread > skew["max_s"]:
-            skew["max_s"], skew["at_step"] = spread, step
-    if spreads:
-        skew["mean_s"] = sum(spreads) / len(spreads)
-    last_steps = [p["last_step"] for p in procs]
-    return {"processes": procs,
-            "step_lag": (max(last_steps) - min(last_steps))
-            if last_steps else 0,
-            "skew": skew, "warnings": warnings}
+    return fleet_view(runs)
 
 
 def format_fleet(fleet: Dict[str, Any]) -> str:
-    lines = [f"== fleet view ({len(fleet['processes'])} processes) =="]
-    for w in fleet.get("warnings", []):
-        lines.append(f"WARNING: {w}")
-    for p in sorted(fleet["processes"],
-                    key=lambda r: r["process_index"]):
-        lines.append(
-            f"process {p['process_index']:<3} last step "
-            f"{p['last_step']:<6} p50 {p['p50_s']*1e3:8.2f} ms  "
-            f"p95 {p['p95_s']*1e3:8.2f} ms  wall {p['wall_s']:7.2f}s  "
-            f"nonfinite {p['nonfinite_steps']}  ({p['path']})")
-    lines.append(f"step lag (fastest - slowest last step): "
-                 f"{fleet['step_lag']}")
-    skew = fleet["skew"]
-    if skew["at_step"] is not None:
-        lines.append(f"step skew: max {skew['max_s']*1e3:.2f} ms at step "
-                     f"{skew['at_step']}, mean {skew['mean_s']*1e3:.2f} ms")
-    else:
-        lines.append("step skew: n/a (no step index seen by >1 process)")
-    return "\n".join(lines)
+    from bigdl_tpu.telemetry.fleet import format_fleet_view
+
+    return format_fleet_view(fleet)

@@ -54,6 +54,12 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # infer_ms / fill / requests travel as extra fields — the raw
     # material for `telemetry diff`'s serve_p50/p99/qps metrics
     "serve": {"size": (int,), "dur": _NUM},
+    # per-collective comms attribution (telemetry/comms.py): count =
+    # collective ops in the compiled step, bytes = HloCostAnalysis-style
+    # bytes accessed; payload_bytes / by_axis / by_op / rows /
+    # expected_s / measured_s travel as extra fields — the raw material
+    # for `telemetry diff`'s comms_bytes/comms_s and fleet skew blame
+    "comms": {"count": (int,), "bytes": _NUM},
 }
 
 _BASE: Dict[str, tuple] = {"v": (int,), "ts": _NUM, "pid": (int,),
@@ -95,6 +101,11 @@ STREAM_NAMES = frozenset({
     # certified cluster-consistent by the commit barrier, and a
     # supervised full-cluster restart
     "cluster/peer_lost", "cluster/commit", "cluster/restart",
+    # fleet aggregation (telemetry/fleet.py): the coordinator's live
+    # watcher publishes the completed-step gap and the blamed per-step
+    # excess as gauges, and a rate-limited skew-blame instant whenever
+    # the fleet diverges — the PR-7 watchdog's flight dump carries them
+    "cluster/skew", "fleet/lag_steps", "fleet/skew_s",
     # health findings (telemetry/health.py detectors + policy)
     "health/nonfinite", "health/skip", "health/loss_spike",
     "health/plateau", "health/grad_explosion", "health/halt",

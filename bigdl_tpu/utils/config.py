@@ -28,6 +28,8 @@ test (or an embedding application) can inject overrides with
 | telemetry_device       | BIGDL_TELEMETRY_DEVICE      | device-facts level: off / auto / full |
 | module_scopes          | BIGDL_SCOPES                | jax.named_scope module paths in compiled HLO (default on; off disables attribution) |
 | telemetry_attribution  | BIGDL_ATTRIBUTION           | emit per-module cost-attribution events (one re-lower + HLO parse per step object) |
+| telemetry_comms        | BIGDL_COMMS                 | per-collective comms events (telemetry/comms.py): off / auto (sharded multi-device steps only) / on — one extra local XLA compile per step object |
+| fleet_interval         | BIGDL_FLEET_INTERVAL        | coordinator fleet-watcher poll seconds (telemetry/fleet.py; 0 = off; active only on multi-process runs) |
 | flight_events          | BIGDL_FLIGHT                | crash flight-recorder ring capacity in events (0 = off) |
 | profile_on_health      | BIGDL_PROFILE_ON_HEALTH     | arm a one-shot profiler capture (dir) when the health policy first escalates |
 | metrics_port           | BIGDL_METRICS_PORT          | OpenMetrics/status HTTP endpoint port (0 = ephemeral; unset = off) |
@@ -62,6 +64,7 @@ time inside jitted-program construction):
 | BIGDL_SINGLETON_WAIT  | Engine.check_singleton bounded wait (s) for a lock holder |
 | BIGDL_COORDINATOR_TIMEOUT | Engine._init_distributed bounded jax.distributed join (s, default 300; 0 = unbounded) |
 | BIGDL_PEAK_FLOPS      | telemetry.device MFU denominator override (FLOP/s per device) |
+| BIGDL_PEAK_BW         | telemetry.device comms-bandwidth denominator override (interconnect bytes/s per device) |
 | JAX_PLATFORMS         | honored over externally-registered PJRT plugins via honor_platform_request |
 """
 
@@ -120,6 +123,14 @@ class BigDLConfig:
     module_scopes: bool = True
     # emit per-module attribution events (re-lower + parse per step obj)
     telemetry_attribution: bool = False
+    # per-collective comms events (telemetry/comms.py): off | auto | on.
+    # auto = only for steps whose mesh spans >1 device — the one case
+    # collectives exist.  Costs one extra LOCAL XLA compile per step
+    # object (collectives only exist post-SPMD-partitioning, and jit's
+    # executable cache is not reachable from the lowered program).
+    telemetry_comms: str = "auto"
+    # coordinator-side live fleet watcher poll seconds (0 disables)
+    fleet_interval: float = 2.0
     # crash flight recorder: event-ring capacity (0 disables)
     flight_events: int = 2048
     # arm a one-shot profiler capture when health first escalates
@@ -188,6 +199,9 @@ class BigDLConfig:
             module_scopes=(env.get("BIGDL_SCOPES") or "on").strip().lower()
             not in ("0", "off", "false", "no"),
             telemetry_attribution=_truthy(env.get("BIGDL_ATTRIBUTION")),
+            telemetry_comms=(env.get("BIGDL_COMMS")
+                             or "auto").strip().lower(),
+            fleet_interval=_float("BIGDL_FLEET_INTERVAL", 2.0),
             flight_events=_int("BIGDL_FLIGHT", 2048),
             profile_on_health=env.get("BIGDL_PROFILE_ON_HEALTH") or None,
             # NB: "0" is a VALID port request (ephemeral), so the usual

@@ -1,0 +1,347 @@
+"""Fleet aggregation + step-skew blame (telemetry/fleet.py, ISSUE 10).
+
+Synthetic multi-log unit tests: the blame verdict must name the right
+cause for crafted data-wait / comms / checkpoint / compute gaps (and
+prefer attributable causes over the compute inflation every OTHER host
+shows as collective wait); the live watcher must tail incrementally,
+publish gauges + cluster/skew instants, and surface on /status and
+/metrics; the multi-log --chrome export must produce one per-process
+trace.  The live 2-process end-to-end rides tests/test_multihost.py."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import schema
+from bigdl_tpu.telemetry.fleet import (FleetWatcher, HostState, blame,
+                                       fleet_view, format_fleet_view)
+from bigdl_tpu.utils.config import BigDLConfig, set_config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    set_config(None)
+    yield
+    set_config(None)
+
+
+def _write_host(path, pidx, steps=10, dur=0.1, data_wait=0.0,
+                checkpoint=0.0, comms_s=None, t0=None, run_ts=None,
+                pid_override=None):
+    """Craft one host's run log: per-iteration spans shaped like the
+    Optimizer's (iteration > data_wait [+ checkpoint]), step events with
+    ``dur``, optional comms events with measured_s."""
+    t0 = time.time() if t0 is None else t0
+    with telemetry.run(str(path), meta={"process_index": pidx}):
+        tr = telemetry.get()
+        if comms_s is not None:
+            tr.emit("comms", count=2, bytes=1 << 20,
+                    payload_bytes=1 << 19, measured_s=comms_s)
+        for i in range(1, steps + 1):
+            it = tr.begin("train/iteration", step=i)
+            dw = tr.begin("data_wait")
+            tr.end(dw)
+            # overwrite the measured span dur with the crafted value
+            tr.emit("step", step=i, dur=dur, records=16,
+                    throughput=16.0 / dur)
+            if checkpoint:
+                sid = tr.begin("checkpoint")
+                tr.end(sid)
+            tr.end(it)
+    # post-process: JSONL is append-only text — rewrite the crafted
+    # component durations directly (simpler than faking wall time)
+    lines = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            ev = json.loads(line)
+            if ev.get("kind") == "span_end":
+                if ev["name"] == "data_wait":
+                    ev["dur"] = data_wait
+                elif ev["name"] == "checkpoint":
+                    ev["dur"] = checkpoint
+                elif ev["name"] == "train/iteration":
+                    ev["dur"] = dur
+            if run_ts is not None and ev.get("kind") == "run_start":
+                ev["ts"] = run_ts
+            if pid_override is not None:
+                # crafted fleet logs come from ONE pytest process: give
+                # each synthetic host its own OS-pid lane
+                ev["pid"] = pid_override
+            lines.append(json.dumps(ev))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def _states(tmp_path, specs):
+    """specs: {pidx: kwargs for _write_host}; returns HostStates."""
+    states = []
+    for pidx, kw in specs.items():
+        path = tmp_path / f"run-x-p{pidx}-1.jsonl"
+        _write_host(path, pidx, **kw)
+        st = HostState(str(path))
+        st.fold(schema.read_events(str(path))[0])
+        states.append(st)
+    return states
+
+
+# -- blame picks the right cause ---------------------------------------------
+def test_blame_data_wait_gap(tmp_path):
+    """The injected-slow-input shape: the laggard's own data_wait is
+    high, every OTHER host's step time is equally inflated (collective
+    wait inside compute) — blame must land on the data-wait host, not
+    on the hosts whose compute merely mirrors it."""
+    states = _states(tmp_path, {
+        0: dict(dur=0.30, data_wait=0.01),   # compute residual 0.29
+        1: dict(dur=0.30, data_wait=0.25),   # the actual straggler
+        2: dict(dur=0.30, data_wait=0.01),
+    })
+    v = blame(states)
+    assert v is not None
+    assert v["laggard"] == 1 and v["cause"] == "data_wait"
+    assert v["excess_s"] == pytest.approx(0.24, abs=0.02)
+
+
+def test_blame_comms_gap(tmp_path):
+    states = _states(tmp_path, {
+        0: dict(dur=0.10, comms_s=0.005),
+        1: dict(dur=0.10, comms_s=0.06),
+        2: dict(dur=0.10, comms_s=0.005),
+    })
+    v = blame(states)
+    assert v["laggard"] == 1 and v["cause"] == "comms"
+
+
+def test_blame_checkpoint_gap(tmp_path):
+    states = _states(tmp_path, {
+        0: dict(dur=0.40, checkpoint=0.3),
+        1: dict(dur=0.40, checkpoint=0.01),
+    })
+    v = blame(states)
+    assert v["laggard"] == 0 and v["cause"] == "checkpoint"
+
+
+def test_blame_compute_fallback(tmp_path):
+    """Nothing attributable: the genuinely-slow-compute host (thermal
+    throttle shape) is named via the residual."""
+    states = _states(tmp_path, {
+        0: dict(dur=0.10, data_wait=0.01),
+        1: dict(dur=0.35, data_wait=0.01),
+    })
+    v = blame(states)
+    assert v["laggard"] == 1 and v["cause"] == "compute"
+
+
+def test_blame_healthy_fleet_and_single_host(tmp_path):
+    states = _states(tmp_path, {
+        0: dict(dur=0.10, data_wait=0.01),
+        1: dict(dur=0.10, data_wait=0.012),
+    })
+    assert blame(states) is None
+    assert blame(states[:1]) is None
+
+
+def test_blame_stalled_host(tmp_path):
+    """A host that stopped stepping (crash/wedge) lags in completed
+    steps with no per-step component gap — blamed as 'stalled'."""
+    states = _states(tmp_path, {
+        0: dict(dur=0.10, steps=12),
+        1: dict(dur=0.10, steps=4),
+    })
+    v = blame(states)
+    assert v["laggard"] == 1 and v["cause"] == "stalled"
+    assert v["lag_steps"] == 8
+
+
+# -- the one-shot view --------------------------------------------------------
+def test_fleet_view_rows_and_format(tmp_path):
+    states_dir = tmp_path
+    for pidx, kw in {0: dict(dur=0.1, data_wait=0.08),
+                     1: dict(dur=0.1, data_wait=0.01)}.items():
+        _write_host(states_dir / f"run-a-p{pidx}-1.jsonl", pidx, **kw)
+    loaded = [(str(p), schema.read_events(str(p))[0])
+              for p in sorted(states_dir.glob("run-*.jsonl"))]
+    view = fleet_view(loaded)
+    assert set(view["hosts"]) == {"p0", "p1"}
+    assert view["hosts"]["p0"]["data_wait_share"] > 0.5
+    assert view["blame"]["laggard"] == 0
+    text = format_fleet_view(view)
+    assert "skew blame: p0 — data_wait" in text
+    assert "data" in text and "comms" in text
+
+
+def test_fleet_cli_one_shot_dir_and_json(tmp_path, capsys):
+    from bigdl_tpu.telemetry import __main__ as cli
+
+    for pidx in (0, 1):
+        _write_host(tmp_path / f"run-b-p{pidx}-1.jsonl", pidx, dur=0.05)
+    rc = cli.main(["fleet", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "fleet view (2 processes)" in out
+    rc = cli.main(["fleet", str(tmp_path), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and set(doc["hosts"]) == {"p0", "p1"}
+    # an empty dir is an error, not an empty table
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    assert cli.main(["fleet", str(empty)]) == 2
+
+
+# -- the live watcher ---------------------------------------------------------
+def test_watcher_tails_incrementally_and_emits_skew(tmp_path):
+    p0 = tmp_path / "run-c-p0-1.jsonl"
+    p1 = tmp_path / "run-c-p1-1.jsonl"
+    _write_host(p0, 0, steps=6, dur=0.2, data_wait=0.01)
+    watcher = FleetWatcher(str(tmp_path), interval=60)  # manual polls
+    sink = telemetry.MemorySink()
+    with telemetry.run(sinks=[sink]):
+        watcher.poll_once()
+        snap = watcher.snapshot()
+        assert set(snap["hosts"]) == {"p0"}
+        assert snap["blame"] is None  # one host: nothing to compare
+    # the second host appears AFTER the first poll — the tail picks
+    # it up and the verdict fires on the next one
+    _write_host(p1, 1, steps=6, dur=0.2, data_wait=0.15)
+    with telemetry.run(sinks=[sink]):
+        watcher.poll_once()
+        snap = watcher.snapshot()
+        assert set(snap["hosts"]) == {"p0", "p1"}
+        assert snap["blame"]["laggard"] == 1
+        assert snap["blame"]["cause"] == "data_wait"
+    watcher.stop()
+    skews = [e for e in sink.events
+             if e.get("kind") == "event" and e.get("name") == "cluster/skew"]
+    assert skews and skews[-1]["laggard"] == 1
+    assert skews[-1]["cause"] == "data_wait"
+    assert schema.validate_events(sink.events) == []
+    gauges = {e["name"] for e in sink.events if e.get("kind") == "gauge"}
+    assert "fleet/lag_steps" in gauges and "fleet/skew_s" in gauges
+    # same verdict inside the cooldown: no instant spam
+    with telemetry.run(sinks=[sink]):
+        n = len(skews)
+        watcher2 = FleetWatcher(str(tmp_path), interval=60)
+        watcher2.poll_once()
+        watcher2.poll_once()
+        watcher2.stop()
+    skews2 = [e for e in sink.events
+              if e.get("kind") == "event"
+              and e.get("name") == "cluster/skew"]
+    assert len(skews2) == n + 1  # one per fresh watcher verdict
+
+
+def test_watcher_starts_on_coordinator_of_multiprocess_run(tmp_path):
+    """start_run wires the watcher only for process 0 of a multi-process
+    run, and /status + /metrics carry the fleet block while it lives."""
+    set_config(BigDLConfig(metrics_port=0, fleet_interval=0.2))
+    # a peer's log already in the dir
+    _write_host(tmp_path / "run-d-p1-9.jsonl", 1, steps=4, dur=0.05)
+    telemetry.start_run(str(tmp_path),
+                        meta={"process_index": 0, "process_count": 2})
+    try:
+        assert telemetry.fleet_watcher() is not None
+        tr = telemetry.get()
+        for i in range(1, 5):
+            tr.emit("step", step=i, dur=0.05, records=8)
+        telemetry.fleet_watcher().poll_once()
+        port = telemetry.metrics_server().port
+        st = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=5))
+        fleet = st.get("fleet") or {}
+        assert fleet.get("dir") == str(tmp_path)
+        assert "p1" in (fleet.get("hosts") or {})
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "bigdl_fleet_hosts" in body
+        assert 'bigdl_fleet_last_step{process_index="1"}' in body
+        assert body.rstrip().endswith("# EOF")
+    finally:
+        telemetry.end_run()
+    assert telemetry.fleet_watcher() is None
+
+
+def test_watcher_not_started_for_single_process_or_non_coordinator(
+        tmp_path):
+    set_config(BigDLConfig(fleet_interval=0.2))
+    with telemetry.run(str(tmp_path), meta={"process_index": 0,
+                                            "process_count": 1}):
+        assert telemetry.fleet_watcher() is None
+    with telemetry.run(str(tmp_path), meta={"process_index": 1,
+                                            "process_count": 2}):
+        assert telemetry.fleet_watcher() is None
+    set_config(BigDLConfig(fleet_interval=0.0))
+    with telemetry.run(str(tmp_path), meta={"process_index": 0,
+                                            "process_count": 2}):
+        assert telemetry.fleet_watcher() is None
+
+
+def test_watcher_dedupes_reincarnation_logs(tmp_path):
+    """Two logs for one rank (supervisor restart): the snapshot keeps
+    the newest incarnation only."""
+    _write_host(tmp_path / "run-old-p0-1.jsonl", 0, steps=3, dur=0.05,
+                run_ts=1000.0)
+    _write_host(tmp_path / "run-new-p0-2.jsonl", 0, steps=7, dur=0.05,
+                run_ts=2000.0)
+    watcher = FleetWatcher(str(tmp_path), interval=60)
+    watcher.poll_once()
+    snap = watcher.snapshot()
+    assert len(snap["hosts"]) == 1
+    assert snap["hosts"]["p0"]["last_step"] == 7
+    assert snap["hosts"]["p0"]["path"].endswith("run-new-p0-2.jsonl")
+    watcher.stop()
+
+
+# -- multi-log chrome export --------------------------------------------------
+def test_multi_log_chrome_export_has_process_lanes(tmp_path, capsys):
+    from bigdl_tpu.telemetry import __main__ as cli
+
+    paths = []
+    for pidx in (0, 1):
+        p = tmp_path / f"run-e-p{pidx}-1.jsonl"
+        _write_host(p, pidx, steps=3, dur=0.02,
+                    pid_override=1000 + pidx)
+        paths.append(str(p))
+    out_path = tmp_path / "fleet_trace.json"
+    rc = cli.main(paths + ["--chrome", str(out_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet view (2 processes)" in out
+    assert "2 process lanes" in out
+    doc = json.loads(out_path.read_text())
+    metas = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    labels = {e["args"]["name"] for e in metas}
+    assert any(lbl.startswith("p0 ") for lbl in labels), labels
+    assert any(lbl.startswith("p1 ") for lbl in labels), labels
+    # both processes' step events landed in one trace
+    steps = [e for e in doc["traceEvents"] if e.get("cat") == "step"]
+    assert len({e["pid"] for e in steps}) <= 2 and steps
+
+
+def test_cluster_watchdog_flight_dump_carries_fleet_snapshot(tmp_path):
+    """The PR-7 watchdog's peer-lost dump includes the live fleet table
+    when a watcher is running — the 'who was dragging before the loss'
+    evidence."""
+    from bigdl_tpu.parallel.cluster import ClusterMonitor
+
+    set_config(BigDLConfig(metrics_port=None, fleet_interval=0.2,
+                           telemetry_dir=str(tmp_path)))
+    _write_host(tmp_path / "run-f-p1-3.jsonl", 1, steps=3, dur=0.05)
+    telemetry.start_run(str(tmp_path),
+                        meta={"process_index": 0, "process_count": 2})
+    try:
+        telemetry.fleet_watcher().poll_once()
+        mon = ClusterMonitor(str(tmp_path / "hb"), 0, 2, deadline=1.0,
+                             abort=False)
+        mon._lost[1] = "test: peer gone"
+        mon._fire()
+        recorder = telemetry.flight_recorder()
+        assert recorder is not None
+        dump_path = recorder.last_dump_path
+        assert dump_path, "no flight dump written"
+        doc = json.loads(open(dump_path).read())
+        assert "fleet" in doc.get("evidence", {}), doc.get("evidence")
+        assert "p1" in doc["evidence"]["fleet"]["hosts"]
+    finally:
+        telemetry.end_run()

@@ -480,25 +480,39 @@ def test_fleet_view_reports_skew_and_lag(tmp_path, capsys):
     assert cli.main([str(p0), str(p1), "--validate"]) == 0
 
 
-def test_fleet_duplicate_process_index_warns_and_excludes(tmp_path,
-                                                          capsys):
-    """Two logs claiming one process_index (stale glob mixing runs) must
-    warn and stay out of the skew math instead of silently overwriting
-    each other's timestamps."""
+def test_fleet_duplicate_process_index_merges_latest_incarnation(
+        tmp_path, capsys):
+    """Two logs claiming one process_index are what a supervisor
+    restart produces (two incarnations of the same rank): the fleet
+    view must keep the LATEST run per rank instead of double-counting
+    skew across incarnations — the superseded log is reported, never
+    silently dropped."""
+    import time as _time
+
     from bigdl_tpu.telemetry import __main__ as cli
     from bigdl_tpu.telemetry.report import fleet_summarize
 
     paths = [tmp_path / n for n in ("old_p0.jsonl", "new_p0.jsonl",
                                     "p1.jsonl")]
-    for p, pidx in zip(paths, (0, 0, 1)):
-        _write_run(p, 0.010, steps=5, pidx=pidx)
+    _write_run(paths[0], 0.010, steps=3, pidx=0)  # dead incarnation
+    _time.sleep(0.05)  # run_start ts orders the incarnations
+    _write_run(paths[1], 0.010, steps=5, pidx=0)
+    _write_run(paths[2], 0.010, steps=5, pidx=1)
     loaded = [(str(p), schema.read_events(str(p))[0]) for p in paths]
     fleet = fleet_summarize(loaded)
-    assert len(fleet["processes"]) == 3  # all stay visible
-    assert fleet["warnings"] and "duplicate process_index 0" \
-        in fleet["warnings"][0]
+    # one row per RANK, and rank 0's row is the newest incarnation
+    assert len(fleet["processes"]) == 2
+    by_pidx = {p["process_index"]: p for p in fleet["processes"]}
+    assert by_pidx[0]["path"].endswith("new_p0.jsonl")
+    assert by_pidx[0]["last_step"] == 5
+    assert fleet["step_lag"] == 0  # the dead incarnation's 3 steps
+    # don't fake a lag
+    assert fleet["superseded"] == [str(paths[0])]
+    assert fleet["notes"] and "kept latest" in fleet["notes"][0]
     assert cli.main([str(p) for p in paths]) == 0
-    assert "WARNING: duplicate process_index" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "note:" in out and "superseded" in out
+    assert "WARNING" not in out
 
 
 def test_schema_accepts_health_kind():

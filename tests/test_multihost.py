@@ -206,6 +206,67 @@ def test_two_process_preempt_resume_matches_uninterrupted(tmp_path):
     _assert_same_params(resumed, un)
 
 
+@pytest.mark.deadline(420)
+def test_two_process_fleet_observability_blames_slow_host(tmp_path):
+    """The ISSUE 10 acceptance path, one live 2-process run covering the
+    whole comms/fleet stack: process 1 carries an injected 250 ms/batch
+    data-pipeline stall (a ``peer_wedge``-style slowdown that drags
+    every synchronous step), both workers write telemetry into ONE
+    shared dir, and
+
+    - the coordinator's live ``/status`` shows the ``fleet`` block with
+      per-host rows and ``bigdl_fleet_*`` gauges on ``/metrics``
+      (asserted inside the worker — FLEET_STATUS_OK);
+    - both run logs validate against the schema, including the new
+      ``comms`` events with nonzero collective bytes on the sharded
+      step and the coordinator's ``cluster/skew`` instants;
+    - the one-shot fleet view over the dir blames p1 with cause
+      ``data_wait`` — not p0, whose inflated compute is just the
+      collective waiting on the straggler."""
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    _run_cluster(tmp_path, "fleet",
+                 BIGDL_TEST_FLEET=1, BIGDL_TEST_ITERS=10,
+                 BIGDL_TEST_SLOW_P=1, BIGDL_TEST_SLOW_MS=250,
+                 BIGDL_TELEMETRY=str(tele), BIGDL_METRICS_PORT=0,
+                 BIGDL_FLEET_INTERVAL="0.3")
+    import glob
+
+    from bigdl_tpu.telemetry import schema
+    from bigdl_tpu.telemetry.fleet import fleet_view
+
+    logs = sorted(glob.glob(str(tele / "run-*.jsonl")))
+    assert len(logs) == 2, logs
+    loaded = []
+    by_pidx = {}
+    for path in logs:
+        events, parse_errors = schema.read_events(path)
+        assert parse_errors == [], parse_errors
+        assert schema.validate_events(events) == [], path
+        loaded.append((path, events))
+        pidx = next(e["meta"].get("process_index") for e in events
+                    if e.get("kind") == "run_start")
+        by_pidx[pidx] = events
+    # comms events with nonzero collective bytes on the sharded step
+    for pidx, events in by_pidx.items():
+        comms = [e for e in events if e.get("kind") == "comms"]
+        assert comms, f"p{pidx} emitted no comms event"
+        assert comms[-1]["bytes"] > 0 and comms[-1]["count"] > 0
+        assert "data" in comms[-1].get("by_axis", {}), comms[-1]
+    # the coordinator's live watcher called the divergence
+    skews = [e for e in by_pidx[0]
+             if e.get("kind") == "event" and e.get("name") == "cluster/skew"]
+    assert skews, "coordinator emitted no cluster/skew instant"
+    assert skews[-1]["laggard"] == 1 and skews[-1]["cause"] == "data_wait"
+    # the one-shot fleet view reaches the same verdict
+    view = fleet_view(loaded)
+    assert set(view["hosts"]) == {"p0", "p1"}
+    verdict = view["blame"]
+    assert verdict is not None, view
+    assert verdict["laggard"] == 1 and verdict["cause"] == "data_wait", \
+        verdict
+
+
 @pytest.mark.deadline(300)
 def test_two_process_sharded_validation_matches_full(tmp_path):
     """Validation shards round-robin over processes and merges

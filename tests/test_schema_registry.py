@@ -33,7 +33,12 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           # persistent compile cache — a silent drop reverts models to
           # N-times-unrolled lowering and unmeasured cache traffic
           "bigdl_tpu/nn/layers/scan.py",
-          "bigdl_tpu/utils/compile_cache.py"]
+          "bigdl_tpu/utils/compile_cache.py",
+          # fleet-wide comms observability (ISSUE 10): the collective
+          # walker the bytes-moved diff gate reads, and the live
+          # cross-host aggregator behind /status.fleet + skew blame
+          "bigdl_tpu/telemetry/comms.py",
+          "bigdl_tpu/telemetry/fleet.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

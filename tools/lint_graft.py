@@ -38,6 +38,12 @@ PINNED_MODULES = [
     "bigdl_tpu/telemetry/schema.py",
     "bigdl_tpu/telemetry/flight.py",
     "bigdl_tpu/telemetry/metrics_http.py",
+    # fleet-wide comms observability (ISSUE 10): losing comms.py blinds
+    # the bytes-moved gate the ZeRO/pipeline work lands against; losing
+    # fleet.py silently reverts cross-host visibility to after-the-fact
+    # log merges with no skew blame
+    "bigdl_tpu/telemetry/comms.py",
+    "bigdl_tpu/telemetry/fleet.py",
     # the kernel library (PR 6): losing any of these silently reverts
     # hot paths to unfused XLA chains and wrong-by-autodiff VJPs
     "bigdl_tpu/ops/dispatch.py",

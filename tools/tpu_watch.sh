@@ -125,6 +125,23 @@ if cl:
         cells.append(cell)
     if cells:
         line += " peers=" + ",".join(cells)
+# comms attribution (telemetry/comms.py): collective bytes per compiled
+# step — a babysitter sees whether a sharding change blew up the
+# all-reduce bill without waiting for the post-run diff
+comms = st.get("comms") or {}
+if comms.get("bytes"):
+    line += (f" comms={comms['bytes'] / 1e6:.1f}MB/step"
+             f"@{comms.get('count', '?')}coll")
+# fleet watcher (telemetry/fleet.py, coordinator only): host count,
+# completed-step lag, and the skew-blame verdict — "one host is slow,
+# whose fault?" answered on one line
+fl = st.get("fleet") or {}
+if fl.get("hosts"):
+    line += f" fleet={len(fl['hosts'])}h/lag{fl.get('lag_steps', 0)}"
+    bl = fl.get("blame") or {}
+    if bl.get("cause"):
+        line += (f" blame=p{bl.get('laggard', '?')}:{bl['cause']}"
+                 f"+{bl.get('excess_s', 0) * 1e3:.0f}ms")
 print(line)
 PY
 }
