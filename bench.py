@@ -336,6 +336,19 @@ def _run_config_timed(name, batch, iters):
                 out["comms_s"] = round(cf["expected_s"], 6)
     except Exception:  # noqa: BLE001 - the snapshot is an observer
         pass
+    # memory snapshot off the SAME scan executable (telemetry/memory.py
+    # while-body recursion reports the peak INSIDE the scanned step):
+    # `--diff-against --memory-budget` gates per-device HBM exactly
+    # like MFU — the "ZeRO-1 drops optimizer HBM" CI claim
+    try:
+        from bigdl_tpu.telemetry import memory as _tmem
+
+        mrow = _tmem.analyze_hlo_memory(step._scan_cache[1].as_text())
+        out["peak_hbm_bytes"] = int(mrow["peak_bytes"])
+        out["hbm_categories"] = {
+            k: int(v) for k, v in mrow["categories"].items() if v}
+    except Exception:  # noqa: BLE001 - the snapshot is an observer
+        pass
     return out
 
 
@@ -611,6 +624,13 @@ def main(argv=None):
                          "baseline exits 4 like any other regression "
                          "(default: the diff engine's compile threshold,"
                          " 50%%)")
+    ap.add_argument("--memory-budget", type=float, default=None,
+                    metavar="PCT",
+                    help="memory budget for --diff-against: a config "
+                         "whose peak_hbm_bytes grew more than PCT%% "
+                         "over the baseline exits 4 like any other "
+                         "regression (default: the diff engine's "
+                         "memory threshold, 10%%)")
     args = ap.parse_args(argv)
     _init_backend_or_die()
     # BIGDL_TELEMETRY routes the sweep's per-config stage timings,
@@ -632,6 +652,8 @@ def main(argv=None):
             kwargs["threshold_pct"] = args.diff_threshold_pct
         if args.compile_budget is not None:
             kwargs["compile_threshold_pct"] = args.compile_budget
+        if args.memory_budget is not None:
+            kwargs["memory_threshold_pct"] = args.memory_budget
         rows = tdiff.diff_metrics(base, cur, **kwargs)
         print(tdiff.format_diff(rows, base, cur), file=sys.stderr)
         if not rows:

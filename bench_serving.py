@@ -207,6 +207,18 @@ def main(argv=None) -> int:
                                      senders=args.senders)
             steady = server.executor.compile_count - warm_compiles
             row = dict(stats)
+            try:
+                # resident-executable HBM (weights + generated code +
+                # largest bucket scratch): the serving-side
+                # peak_hbm_bytes the diff gate compares, and the number
+                # the KV-cache budgeting work subtracts from the device
+                mem = server.executor.memory_summary()
+                row["peak_hbm_bytes"] = mem["resident_bytes"]
+                row["executable_memory"] = {
+                    k: mem[k] for k in ("state_bytes", "code_bytes",
+                                        "peak_temp_bytes")}
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
             row.update(
                 steady_compiles=steady,
                 retrace_diagnostics=len(mon.report.diagnostics),

@@ -440,6 +440,9 @@ def cmd_attribute(args) -> None:
 
     from bigdl_tpu.telemetry import attribution
 
+    if args.comms and args.memory:
+        raise SystemExit("--comms and --memory are different views — "
+                         "pass one")
     if args.comms:
         from bigdl_tpu.telemetry import comms
 
@@ -448,6 +451,15 @@ def cmd_attribute(args) -> None:
             sync=args.sync)
         print(json.dumps(result, indent=2, default=str) if args.json
               else comms.format_comms(result))
+        return
+    if args.memory:
+        from bigdl_tpu.telemetry import memory as tmem
+
+        result = tmem.attribute_memory_model(
+            args.model, batch=args.batch_size, devices=args.mesh,
+            sync=args.sync)
+        print(json.dumps(result, indent=2, default=str) if args.json
+              else tmem.format_memory(result))
         return
     result = attribution.attribute_model(
         args.model, batch=args.batch_size, train=not args.forward)
@@ -597,12 +609,18 @@ def main(argv=None) -> None:
     at.add_argument("--comms", action="store_true",
                     help="per-collective comms view: bytes moved, mesh "
                          "axes, owning modules (telemetry/comms.py)")
+    at.add_argument("--memory", action="store_true",
+                    help="per-module HBM view: params / optimizer "
+                         "state / activations-at-peak per device "
+                         "(telemetry/memory.py)")
     at.add_argument("--mesh", type=int, default=0, metavar="N",
-                    help="(--comms) data-axis mesh size to shard over "
-                         "(default: all local devices)")
+                    help="(--comms/--memory) data-axis mesh size to "
+                         "shard over (default: all local devices for "
+                         "--comms, single device for --memory)")
     at.add_argument("--sync", default="allreduce",
                     choices=("allreduce", "sharded", "fsdp"),
-                    help="(--comms) parameter_sync mode to compile with")
+                    help="(--comms/--memory) parameter_sync mode to "
+                         "compile with")
     at.add_argument("--json", action="store_true")
     # same default batch as `python -m bigdl_tpu.telemetry attribute`:
     # the two front-ends of one table must print the same numbers

@@ -41,6 +41,7 @@ from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.parallel.train_step import EvalStep, TrainStep
+from bigdl_tpu.telemetry.memory import MemoryExhaustedError
 from bigdl_tpu.telemetry.health import (HealthError, HealthPolicy,
                                         probe_stats)
 from bigdl_tpu.utils import file as File
@@ -957,6 +958,14 @@ class Optimizer:
                     # steps' events + the halting evidence for the
                     # postmortem.
                     self._flight_dump("health_halt", e.evidence)
+                    raise
+                except MemoryExhaustedError:
+                    # OOM is deterministic for a fixed program: a
+                    # checkpoint restore replays the same allocation
+                    # and dies again, so burning the retry budget on it
+                    # only delays the verdict.  The evidence (largest
+                    # buffers, categories, live-vs-limit) was flight-
+                    # dumped at the raise site (telemetry/memory.py).
                     raise
                 except Exception as e:  # noqa: BLE001 — retry loop parity
                     now = time.time()

@@ -463,7 +463,12 @@ class TrainStep:
             # 1.0 vs NaN cannot retrace)
             args += (jnp.float32(1.0 if grad_scale is None
                                  else grad_scale),)
-        out = self._compiled(*args)
+        try:
+            out = self._compiled(*args)
+        except Exception as e:  # noqa: BLE001 - OOM forensics only
+            self._maybe_raise_oom(e, "TrainStep.run_sharded",
+                                  x=x, y=y)
+            raise
         if self.health_probe:
             (self.params, self.opt_state, self.buffers, loss,
              self.last_health) = out
@@ -491,7 +496,8 @@ class TrainStep:
         cfg = get_config()
         level = cfg.telemetry_device
         comms_on = self._comms_enabled(cfg)
-        if level == "off" and not comms_on:
+        memory_on = self._memory_enabled(cfg)
+        if level == "off" and not comms_on and not memory_on:
             return
 
         def relower():
@@ -501,12 +507,33 @@ class TrainStep:
             return self._compiled.lower(*largs)
 
         lowered = None
+        # the comms AND memory walkers both read the POST-SPMD-
+        # partitioning HLO (collectives and the schedule don't exist in
+        # the lowered StableHLO), so the one extra LOCAL XLA compile per
+        # step object is SHARED: with both enabled, the second event is
+        # a text parse.  Same class of cost as BIGDL_TELEMETRY_DEVICE=
+        # full, and why both `auto` modes fire only on multi-device
+        # meshes.
+        compiled = None
+
+        def recompile():
+            nonlocal lowered, compiled
+            if compiled is None:
+                if lowered is None:
+                    lowered = relower()
+                compiled = lowered.compile()
+            return compiled
+
         if level != "off":
             try:
                 lowered = relower()
                 facts = _tdev.collect_device_facts(
                     lowered, (self.params, self.opt_state, self.buffers),
-                    level=level)
+                    level="auto" if level == "full" else level)
+                if level == "full":
+                    # the full-level HBM breakdown off the SAME compile
+                    # the comms/memory walkers share
+                    facts.update(_tdev.memory_facts(recompile()))
             except Exception:  # noqa: BLE001 - facts never fail the step
                 facts = None
             if facts:
@@ -524,24 +551,23 @@ class TrainStep:
                 except Exception:  # noqa: BLE001 - an observer
                     pass
         if comms_on:
-            # per-collective comms rows need the POST-SPMD-partitioning
-            # HLO (collectives don't exist in the lowered StableHLO), so
-            # this pays one extra LOCAL XLA compile per step object —
-            # the same class of cost as BIGDL_TELEMETRY_DEVICE=full,
-            # and why `auto` fires only on multi-device meshes.
             # Independent of the device-facts level: BIGDL_COMMS has its
             # own off switch, and TELEMETRY_DEVICE=off must not mute it.
             try:
                 from bigdl_tpu.telemetry import comms as _comms
 
-                if lowered is None:
-                    lowered = relower()
-                payload = _comms.comms_facts(lowered.compile(),
+                payload = _comms.comms_facts(recompile(),
                                              mesh=self.mesh,
                                              model=self.model)
                 payload["program"] = "train_step"
                 tracer.emit("comms", **payload)
             except Exception:  # noqa: BLE001 - comms is an observer
+                pass
+        if memory_on:
+            try:
+                self._emit_memory_event(tracer, recompile(),
+                                        program="train_step")
+            except Exception:  # noqa: BLE001 - memory is an observer
                 pass
 
     def _comms_enabled(self, cfg) -> bool:
@@ -555,6 +581,47 @@ class TrainStep:
         if mode in ("1", "on", "true", "yes"):
             return True
         return self.mesh is not None and self.mesh.devices.size > 1
+
+    def _memory_enabled(self, cfg) -> bool:
+        """Whether this step emits the per-step ``memory`` event
+        (telemetry/memory.py): ``BIGDL_MEMORY`` on / off / auto, auto =
+        multi-device meshes only — where per-device HBM is the scaling
+        question and the comms event already pays the shared compile."""
+        mode = (cfg.telemetry_memory or "auto").strip().lower()
+        if mode in ("0", "off", "false", "no"):
+            return False
+        if mode in ("1", "on", "true", "yes"):
+            return True
+        return self.mesh is not None and self.mesh.devices.size > 1
+
+    def _emit_memory_event(self, tracer, compiled, program: str) -> None:
+        """One ``memory`` event off an in-hand executable: the walker's
+        per-device peak + categories + per-module rows + live allocator
+        stats; a ``memory/pressure`` instant when any device's live
+        peak is within 5% of its limit."""
+        from bigdl_tpu.telemetry import memory as _tmem
+
+        payload = _tmem.memory_facts_compiled(compiled, model=self.model)
+        # the event must stay a log line, not a log file: cap the row
+        # and buffer tables (the CLI recomputes full tables on demand)
+        payload["rows"] = sorted(payload.get("rows", []),
+                                 key=lambda r: -r["total_bytes"])[:24]
+        payload["largest"] = payload.get("largest", [])[:8]
+        payload.pop("timeline", None)
+        payload["program"] = program
+        tracer.emit("memory", **payload)
+        # judged per device against its OWN allocator bytes_limit (the
+        # reservation-adjusted ceiling RESOURCE_EXHAUSTED fires
+        # against), budget only as the fallback
+        hit = _tmem.pressured_device(payload.get("live"),
+                                     payload.get("hbm_limit_bytes"))
+        if hit:
+            tracer.instant("memory/pressure", device=hit["device"],
+                           peak_bytes_in_use=hit["peak_bytes"],
+                           hbm_limit_bytes=hit["limit_bytes"],
+                           pct_of_limit=round(hit["peak_bytes"]
+                                              / hit["limit_bytes"]
+                                              * 100.0, 2))
 
     def _shard_batch(self, x, y, stacked: bool = False):
         if self.mesh is None:
@@ -610,10 +677,35 @@ class TrainStep:
         ``aot_scan`` first)."""
         if getattr(self, "_scan_cache", None) is None:
             raise RuntimeError("no compiled scan: call run_scan/aot_scan")
-        self.params, self.opt_state, self.buffers, losses = \
-            self._scan_cache[1](self.params, self.opt_state, self.buffers,
-                                x, y, key)
+        try:
+            self.params, self.opt_state, self.buffers, losses = \
+                self._scan_cache[1](self.params, self.opt_state,
+                                    self.buffers, x, y, key)
+        except Exception as e:  # noqa: BLE001 - OOM forensics only
+            self._maybe_raise_oom(e, "TrainStep.run_scan_sharded",
+                                  x=x, y=y)
+            raise
         return losses
+
+    def _maybe_raise_oom(self, exc: Exception, context: str,
+                         x=None, y=None) -> None:
+        """RESOURCE_EXHAUSTED from a dispatch (or an AOT compile)
+        becomes a ``MemoryExhaustedError`` carrying the postmortem:
+        largest known buffers, per-category totals, live-vs-limit —
+        flight-dumped before the re-raise (docs/observability.md "my
+        job OOMed — what was resident?").  Anything else returns and
+        the caller re-raises the original."""
+        from bigdl_tpu.telemetry import memory as _tmem
+
+        if not _tmem.is_oom(exc):
+            return
+        trees = {"params": self.params, "opt_state": self.opt_state,
+                 "buffers": self.buffers}
+        if x is not None:
+            trees["batch_x"] = x
+        if y is not None:
+            trees["batch_y"] = y
+        _tmem.raise_oom(exc, trees, context=context)
 
     def aot_scan(self, x, y, key, n: int, stacked: bool = False):
         """AOT-compile the scan-of-n-steps once; installs the executable
@@ -635,7 +727,14 @@ class TrainStep:
         t0 = time.perf_counter()
         lowered = self._build_scan(n, stacked).lower(
             self.params, self.opt_state, self.buffers, x, y, key)
-        compiled = lowered.compile()
+        try:
+            compiled = lowered.compile()
+        except Exception as e:  # noqa: BLE001 - OOM forensics only
+            # compile-time RESOURCE_EXHAUSTED (the backend sizes the
+            # buffer assignment here) gets the same postmortem a
+            # dispatch OOM does
+            self._maybe_raise_oom(e, "TrainStep.aot_scan", x=x, y=y)
+            raise
         self._scan_cache = ((n, stacked), compiled)
         if tracer is not None:
             tracer.emit("compile", name="TrainStep.aot_scan",
@@ -664,6 +763,16 @@ class TrainStep:
                     payload["program"] = "aot_scan"
                     tracer.emit("comms", **payload)
                 except Exception:  # noqa: BLE001 - comms is an observer
+                    pass
+            if self._memory_enabled(get_config()):
+                # likewise free here: the memory walker reads the same
+                # in-hand executable's scheduled text, and its while-
+                # body recursion reports the peak INSIDE the scanned
+                # step, not the tuple shuffle around it
+                try:
+                    self._emit_memory_event(tracer, compiled,
+                                            program="aot_scan")
+                except Exception:  # noqa: BLE001 - an observer
                     pass
         from bigdl_tpu.telemetry.device import normalize_cost_analysis
         return normalize_cost_analysis(compiled.cost_analysis())

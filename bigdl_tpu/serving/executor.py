@@ -89,6 +89,11 @@ class BucketedExecutor:
         self.warmup_s = 0.0
         self._fwd = self._make_fwd()
         self._exec: Dict[Tuple[int, Optional[int]], Any] = {}
+        # per-bucket executable memory_analysis (recorded at compile
+        # time): the resident-executable HBM the KV-cache budgeting
+        # work (ROADMAP item 2) subtracts from the device budget
+        self.bucket_memory: Dict[Tuple[int, Optional[int]],
+                                 Dict[str, int]] = {}
         self._state = None        # device-placed {path: array}
         self._state_src = None    # host-side identity snapshot
         self._state_sig = None    # {path: (shape, dtype)} of the trace
@@ -188,9 +193,21 @@ class BucketedExecutor:
             sharding = data_sharding(self.mesh, len(spec.shape))
             spec = jax.ShapeDtypeStruct(spec.shape, spec.dtype,
                                         sharding=sharding)
-        compiled = fn.lower(self._state, spec).compile()
+        try:
+            compiled = fn.lower(self._state, spec).compile()
+        except Exception as e:  # noqa: BLE001 - OOM forensics only
+            self._maybe_raise_oom(e, f"ServeExecutor.compile{list(key)}")
+            raise
         self._exec[key] = compiled
         self.compile_count += 1
+        try:
+            from bigdl_tpu.telemetry.device import memory_facts
+
+            mf = memory_facts(compiled)
+            if mf:
+                self.bucket_memory[key] = mf
+        except Exception:  # noqa: BLE001 - accounting is an observer
+            pass
         dur = time.perf_counter() - t0
         tracer = _telemetry.get()
         if tracer is not None:
@@ -198,6 +215,48 @@ class BucketedExecutor:
                         bucket=list(k for k in key if k is not None),
                         cache_size=len(self._exec))
         return compiled
+
+    def _maybe_raise_oom(self, exc: Exception, context: str) -> None:
+        """RESOURCE_EXHAUSTED from a serving compile or dispatch gets
+        the same enriched postmortem the train path raises
+        (telemetry/memory.py): largest resident buffers, categories,
+        live-vs-limit, flight-dumped before the re-raise."""
+        from bigdl_tpu.telemetry import memory as _tmem
+
+        if not _tmem.is_oom(exc):
+            return
+        trees = {"state": self._state if self._state is not None else {}}
+        summary = self.memory_summary()
+        context = (f"{context} (resident executables: "
+                   f"{len(self.bucket_memory)} buckets, "
+                   f"{summary['resident_bytes']} bytes incl. state)")
+        _tmem.raise_oom(exc, trees, context=context)
+
+    def memory_summary(self) -> Dict[str, Any]:
+        """Resident-executable HBM: per-device state (weights) bytes +
+        the per-bucket executable breakdown.  ``resident_bytes`` =
+        state + generated code + the LARGEST bucket temp (buckets run
+        one at a time — their scratch is not additive; code is)."""
+        from bigdl_tpu.telemetry.memory import _leaf_device_bytes
+
+        with self._lock:
+            state_bytes = sum(_leaf_device_bytes(v) for v in
+                              (self._state or {}).values())
+            buckets = {}
+            peak_temp = code = 0
+            for key, mf in sorted(self.bucket_memory.items(),
+                                  key=lambda kv: (kv[0][0],
+                                                  kv[0][1] or -1)):
+                label = f"b{key[0]}" + (f"s{key[1]}"
+                                        if key[1] is not None else "")
+                buckets[label] = dict(mf)
+                peak_temp = max(peak_temp, mf.get("temp_bytes", 0))
+                code += mf.get("code_bytes", 0)
+        return {"state_bytes": int(state_bytes),
+                "code_bytes": int(code),
+                "peak_temp_bytes": int(peak_temp),
+                "resident_bytes": int(state_bytes + code + peak_temp),
+                "buckets": buckets}
 
     def warmup(self, sample_shape: Tuple[int, ...], dtype) -> float:
         """AOT-compile every bucket in the policy for samples of
@@ -292,7 +351,11 @@ class BucketedExecutor:
                 spec = jax.ShapeDtypeStruct(padded.shape, padded.dtype)
                 compiled = self._compile(key, spec, "ServeExecutor.compile")
         xj = self._place_input(jnp.asarray(padded))
-        out = compiled(self._state, xj)
+        try:
+            out = compiled(self._state, xj)
+        except Exception as e:  # noqa: BLE001 - OOM forensics only
+            self._maybe_raise_oom(e, kind)
+            raise
         if _hooks.hooks_active():
             # one executable per kind, forever — the detector sees a
             # constant signature AND a constant cache size per bucket

@@ -199,6 +199,13 @@ class ModelServer:
                           for key in self.executor.warm_buckets()],
             compiles=self.executor.compile_count,
             warmup_s=round(self.executor.warmup_s, 3))
+        try:
+            # resident-executable HBM (weights + code + largest bucket
+            # scratch): the number ROADMAP item 2's KV-cache budget
+            # subtracts from the device before sizing caches
+            st["memory"] = self.executor.memory_summary()
+        except Exception:  # noqa: BLE001 - accounting is an observer
+            pass
         return st
 
     def openmetrics(self) -> str:

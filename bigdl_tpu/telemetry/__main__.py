@@ -19,6 +19,10 @@ Options::
     python -m bigdl_tpu.telemetry attribute run.jsonl        # from a run log
     python -m bigdl_tpu.telemetry attribute --comms --model lenet --mesh 2
     python -m bigdl_tpu.telemetry attribute --comms run.jsonl  # comms view
+    python -m bigdl_tpu.telemetry attribute --memory --model lenet --mesh 2
+    python -m bigdl_tpu.telemetry attribute --memory run.jsonl # HBM view
+    python -m bigdl_tpu.telemetry memory --model transformer --mesh 4 \
+        --zero1 --remat                                  # fit estimator
 
 Passing several run logs merges them into the multi-host fleet view
 (per-process step progress + step-skew + blame); ``--chrome`` then
@@ -74,17 +78,46 @@ def attribute_main(argv) -> int:
                    help="per-collective comms view: bytes moved, mesh "
                         "axes, owning modules, bandwidth vs "
                         "BIGDL_PEAK_BW")
+    p.add_argument("--memory", action="store_true",
+                   help="per-module HBM view: params / optimizer state "
+                        "/ activations-at-peak / workspace per device "
+                        "(telemetry/memory.py)")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
-                   help="(--comms --model) data-axis mesh size to shard "
-                        "over (default: all local devices)")
+                   help="(--comms/--memory --model) data-axis mesh size "
+                        "to shard over (default: all local devices for "
+                        "--comms, single device for --memory)")
     p.add_argument("--sync", default="allreduce",
                    choices=("allreduce", "sharded", "fsdp"),
-                   help="(--comms --model) parameter_sync mode to "
-                        "compile with")
+                   help="(--comms/--memory --model) parameter_sync "
+                        "mode to compile with")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
     if (args.run is None) == (args.model is None):
         p.error("pass exactly one of run.jsonl or --model NAME")
+    if args.comms and args.memory:
+        p.error("--comms and --memory are different views — pass one")
+    if args.memory:
+        from bigdl_tpu.telemetry import memory as memory_mod
+
+        if args.model is not None:
+            result = memory_mod.attribute_memory_model(
+                args.model, batch=args.batch, devices=args.mesh,
+                sync=args.sync)
+        else:
+            events, parse_errors = schema.read_events(args.run)
+            for e in parse_errors:
+                print(f"warning: {args.run}: {e}", file=sys.stderr)
+            result = memory_mod.memory_from_events(events)
+            if result is None:
+                print(f"error: {args.run} has no memory event (sharded "
+                      f"steps emit one by default; BIGDL_MEMORY=on "
+                      f"forces it, or use --model)", file=sys.stderr)
+                return 2
+        if args.json:
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            print(memory_mod.format_memory(result))
+        return 0
     if args.comms:
         from bigdl_tpu.telemetry import comms as comms_mod
 
@@ -161,6 +194,61 @@ def _enrich_measured(result, events) -> None:
         return
 
 
+def memory_main(argv) -> int:
+    """``python -m bigdl_tpu.telemetry memory`` — the device-free fit
+    estimator: lower a registry TrainStep on CPU with the requested
+    mesh/sharding, predict per-device peak HBM, compare against the
+    budget (``BIGDL_HBM_GB`` / the per-chip table), and rank blocks by
+    remat payoff.  Exit 0 = fits (or no budget known), 1 = predicted
+    peak exceeds the budget, 2 = nothing to estimate."""
+    import argparse
+
+    from bigdl_tpu.telemetry import memory as memory_mod
+
+    p = argparse.ArgumentParser(
+        prog="bigdl_tpu.telemetry memory",
+        description="device-free fit estimator: will this model fit on "
+                    "N chips? (predicted per-device peak HBM vs "
+                    "BIGDL_HBM_GB, with a remat advisor)")
+    p.add_argument("--model", required=True,
+                   help="registry model name")
+    p.add_argument("-b", "--batch", type=int, default=8,
+                   help="GLOBAL batch size (default %(default)s)")
+    p.add_argument("--mesh", type=int, default=1, metavar="N",
+                   help="data-axis mesh size to predict for (CPU "
+                        "emulation needs XLA_FLAGS=--xla_force_host_"
+                        "platform_device_count=N)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 layout: optimizer state sharded over "
+                        "the data axis (parameter_sync='sharded')")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3 layout: params + optimizer state "
+                        "sharded (parameter_sync='fsdp')")
+    p.add_argument("--remat", action="store_true",
+                   help="estimate WITH whole-model rematerialization "
+                        "(activations recomputed, not stored)")
+    p.add_argument("--no-advice", action="store_true",
+                   help="skip the remat advisor (one fewer re-lower)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    sync = "fsdp" if args.fsdp else ("sharded" if args.zero1
+                                     else "allreduce")
+    try:
+        result = memory_mod.fit_estimate(
+            args.model, batch=args.batch, devices=args.mesh, sync=sync,
+            remat=args.remat, advise=not args.no_advice)
+    except (KeyError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(memory_mod.format_memory(result))
+    if result.get("fits") is False:
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "diff":
@@ -169,6 +257,8 @@ def main(argv=None) -> int:
         return diff_mod.main(argv[1:])
     if argv and argv[0] == "attribute":
         return attribute_main(argv[1:])
+    if argv and argv[0] == "memory":
+        return memory_main(argv[1:])
     if argv and argv[0] == "fleet":
         from bigdl_tpu.telemetry import fleet as fleet_mod
 
@@ -179,7 +269,7 @@ def main(argv=None) -> int:
         description="summarize / compare / export telemetry run logs "
                     "(subcommands: diff <runA> <runB>, fleet <dir> "
                     "[--watch], attribute [run.jsonl | --model NAME] "
-                    "[--comms])")
+                    "[--comms|--memory], memory --model NAME --mesh N)")
     p.add_argument("runs", nargs="+", metavar="run.jsonl",
                    help="path(s) to run-*.jsonl event logs; several "
                         "merge into the fleet view")

@@ -139,6 +139,13 @@ def scope_of(op_name: str) -> Tuple[str, str]:
             continue
         if not frame or _CALL_FRAME_RE.match(frame) or frame == "pjit":
             continue  # jit(...)/pjit function frames, not module scopes
+        if frame in ("checkpoint", "rematted_computation", "remat"):
+            # jax.checkpoint's recompute-in-backward inserts these as
+            # BARE frames (".../transpose(jvp(2))/checkpoint/
+            # rematted_computation/0/fc1/..."): transform structure,
+            # not module scopes — a Remat-wrapped block's ops must fold
+            # onto the block's own tree path
+            continue
         kept.append(frame)
     return ".".join(kept), ("bwd" if bwd else "fwd")
 

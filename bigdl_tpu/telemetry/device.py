@@ -26,7 +26,7 @@ import os
 from typing import Any, Dict, Optional
 
 __all__ = ["peak_flops_per_device", "peak_bw_per_device",
-           "normalize_cost_analysis",
+           "hbm_per_device", "normalize_cost_analysis",
            "cost_facts", "memory_facts", "live_memory_facts",
            "donated_bytes", "collect_device_facts", "mfu_estimate"]
 
@@ -96,6 +96,38 @@ def peak_bw_per_device(device_kind: str) -> Optional[float]:
             if best is None or len(name) > best[0]:
                 best = (len(name), peak)
     return best[1] if best else None
+
+
+#: per-chip HBM bytes by device_kind prefix (public spec sheets) — the
+#: fit estimator's budget denominator (telemetry/memory.py);
+#: ``BIGDL_HBM_GB`` overrides (and is the only way to describe a
+#: host-capped or MIG-style fractional allocation).
+_HBM_GB = {
+    "TPU v2": 8,
+    "TPU v3": 16,
+    "TPU v4 lite": 8,
+    "TPU v4": 32,
+    "TPU v5 lite": 16,
+    "TPU v5e": 16,
+    "TPU v5p": 95,
+    "TPU v5": 95,
+    "TPU v6 lite": 32,
+    "TPU v6e": 32,
+}
+
+
+def hbm_per_device(device_kind: str) -> Optional[int]:
+    """HBM bytes of one device from the per-chip table, or None when
+    unknown (CPU has no fixed budget; ``BIGDL_HBM_GB`` is resolved by
+    the caller, ``memory.hbm_limit_bytes``, so this stays a pure table
+    lookup)."""
+    kind = (device_kind or "").lower()
+    best = None
+    for name, gb in _HBM_GB.items():
+        if kind.startswith(name.lower()):
+            if best is None or len(name) > best[0]:
+                best = (len(name), gb)
+    return best[1] * (1 << 30) if best else None
 
 
 def normalize_cost_analysis(cost) -> Dict[str, Any]:
@@ -193,7 +225,19 @@ def collect_device_facts(lowered, donated_trees=(), level: str = "auto"
     db = donated_bytes(*donated_trees)
     if db:
         facts["donated_bytes"] = db
+    # live allocator peaks ride the DEFAULT level (one attr read per
+    # device — the runbook's first OOM question must not need `full`);
+    # the flat device-0 keys stay for back-compat, the per-device list
+    # covers multi-chip hosts
     facts.update(live_memory_facts())
+    try:
+        from bigdl_tpu.telemetry.memory import live_hbm
+
+        per_dev = live_hbm()
+        if len(per_dev) > 1:
+            facts["live_memory"] = per_dev
+    except Exception:  # noqa: BLE001 - facts are best-effort
+        pass
     try:
         import jax
 

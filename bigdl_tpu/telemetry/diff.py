@@ -27,9 +27,18 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["load_metrics", "run_log_metrics", "bench_metrics",
            "diff_metrics", "format_diff", "DEFAULT_THRESHOLD_PCT",
-           "DEFAULT_COMPILE_THRESHOLD_PCT"]
+           "DEFAULT_COMPILE_THRESHOLD_PCT",
+           "DEFAULT_MEMORY_THRESHOLD_PCT"]
 
 DEFAULT_THRESHOLD_PCT = 10.0
+
+#: peak_hbm_bytes regression threshold (the memory budget,
+#: docs/observability.md): its own knob because HBM regressions are
+#: STEP-function failures — a model that grew 10% past the headroom
+#: OOMs outright, so CI legs near the budget tighten this to ~2-5%
+#: (``bench.py --memory-budget`` / ``telemetry diff
+#: --memory-threshold-pct``) while roomy legs leave the default.
+DEFAULT_MEMORY_THRESHOLD_PCT = 10.0
 
 #: compile_s regression threshold (the compile budget, docs/compile.md):
 #: looser than the runtime threshold by design — compile wall time is
@@ -66,6 +75,12 @@ _RULES: List[Tuple[str, str, str]] = [
     ("comms_s", "lower", "pct"),
     (".comms_bytes", "lower", "pct"),
     (".comms_s", "lower", "pct"),
+    # memory metrics (telemetry/memory.py): predicted per-device peak
+    # HBM per run log (last memory event) and per bench row — the
+    # "ZeRO-1 drops per-device optimizer HBM" gate, on the dedicated
+    # memory threshold ("pct_memory")
+    ("peak_hbm_bytes", "lower", "pct_memory"),
+    (".peak_hbm_bytes", "lower", "pct_memory"),
     (".images_per_sec", "higher", "pct"),
     (".mfu", "higher", "pct"),
     # serving metrics (bigdl_tpu/serving + bench_serving.py): latency
@@ -143,6 +158,12 @@ def run_log_metrics(path: str) -> Dict[str, Any]:
             out["comms_s"] = float(measured[-1]["measured_s"])
         elif last.get("expected_s") is not None:
             out["comms_s"] = float(last["expected_s"])
+    # memory snapshot (telemetry/memory.py, kind "memory"): the LAST
+    # event describes the step program that ran — peak is exact at
+    # compile time, the number the HBM budget gates
+    memory_events = [e for e in events if e.get("kind") == "memory"]
+    if memory_events and memory_events[-1].get("peak_bytes") is not None:
+        out["peak_hbm_bytes"] = float(memory_events[-1]["peak_bytes"])
     health = summary.get("health", {})
     out["health_events"] = sum(health.get("events", {}).values())
     out["nonfinite_steps"] = health.get("nonfinite_steps", 0)
@@ -191,6 +212,11 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
         for key in ("comms_bytes", "comms_s"):
             if row.get(key) is not None:
                 out[f"{name}.{key}"] = float(row[key])
+        # memory snapshot on bench rows (bench.py off the scan
+        # executable, bench_serving.py off the warm bucket set) — the
+        # --memory-budget gate's input
+        if row.get("peak_hbm_bytes") is not None:
+            out[f"{name}.peak_hbm_bytes"] = float(row["peak_hbm_bytes"])
     if doc.get("value") is not None and not doc.get("configs"):
         out["throughput"] = float(doc["value"])
     if doc.get("mfu") is not None:
@@ -218,15 +244,20 @@ def load_metrics(path: str) -> Dict[str, Any]:
 def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
                  threshold_pct: float = DEFAULT_THRESHOLD_PCT,
                  count_slack: int = 0,
-                 compile_threshold_pct: Optional[float] = None
+                 compile_threshold_pct: Optional[float] = None,
+                 memory_threshold_pct: Optional[float] = None
                  ) -> List[Dict[str, Any]]:
     """Compare metric dicts (A = baseline, B = candidate).  Returns one
     row per comparable metric: ``{name, a, b, delta_pct, better,
     regressed}``, regressions first.  ``compile_threshold_pct`` is the
     compile budget applied to ``compile_s`` metrics (None = the default
-    :data:`DEFAULT_COMPILE_THRESHOLD_PCT`)."""
+    :data:`DEFAULT_COMPILE_THRESHOLD_PCT`); ``memory_threshold_pct``
+    the memory budget applied to ``peak_hbm_bytes`` metrics (None =
+    :data:`DEFAULT_MEMORY_THRESHOLD_PCT`)."""
     if compile_threshold_pct is None:
         compile_threshold_pct = DEFAULT_COMPILE_THRESHOLD_PCT
+    if memory_threshold_pct is None:
+        memory_threshold_pct = DEFAULT_MEMORY_THRESHOLD_PCT
     rows: List[Dict[str, Any]] = []
     for name in sorted(set(a) & set(b)):
         rule = _rule_for(name)
@@ -248,6 +279,8 @@ def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
             regressed = worse and abs(delta) > 1e-9
         elif kind == "pct_compile":
             regressed = worse and abs(delta_pct) > compile_threshold_pct
+        elif kind == "pct_memory":
+            regressed = worse and abs(delta_pct) > memory_threshold_pct
         else:
             regressed = worse and abs(delta_pct) > threshold_pct
         rows.append({"name": name, "a": va, "b": vb,
@@ -302,6 +335,10 @@ def main(argv=None) -> int:
                    help="compile budget: relative regression threshold "
                         "for compile_s metrics (default "
                         f"{DEFAULT_COMPILE_THRESHOLD_PCT})")
+    p.add_argument("--memory-threshold-pct", type=float, default=None,
+                   help="memory budget: relative regression threshold "
+                        "for peak_hbm_bytes metrics (default "
+                        f"{DEFAULT_MEMORY_THRESHOLD_PCT})")
     p.add_argument("--json", action="store_true",
                    help="emit rows as JSON instead of the table")
     args = p.parse_args(argv)
@@ -314,7 +351,8 @@ def main(argv=None) -> int:
         return 2
     rows = diff_metrics(a, b, threshold_pct=args.threshold_pct,
                         count_slack=args.count_slack,
-                        compile_threshold_pct=args.compile_threshold_pct)
+                        compile_threshold_pct=args.compile_threshold_pct,
+                        memory_threshold_pct=args.memory_threshold_pct)
     n_regressed = sum(r["regressed"] for r in rows)
     exit_code = 2 if not rows else (1 if n_regressed else 0)
     if args.json:
@@ -330,6 +368,10 @@ def main(argv=None) -> int:
                               (args.compile_threshold_pct
                                if args.compile_threshold_pct is not None
                                else DEFAULT_COMPILE_THRESHOLD_PCT),
+                          "memory_threshold_pct":
+                              (args.memory_threshold_pct
+                               if args.memory_threshold_pct is not None
+                               else DEFAULT_MEMORY_THRESHOLD_PCT),
                           "count_slack": args.count_slack,
                           "exit_code": exit_code}, indent=2))
     else:

@@ -91,6 +91,13 @@ class HostState:
         self.ckpt_ts: Optional[float] = None
         self.comms_s_per_step = 0.0   # latest comms event's seconds
         self.comms_bytes = 0
+        # latest memory event (telemetry/memory.py): compiled peak +
+        # live allocator peak + limit — the fleet's hbm columns and
+        # the memory-pressure note on the blame verdict
+        self.hbm_peak_bytes = 0
+        self.hbm_live_bytes = 0
+        self.hbm_limit_bytes = 0
+        self._memory_pressured = False
         # (step, ts, dur, components) rows, newest last
         self.window: deque = deque(maxlen=WINDOW_STEPS)
         self._pending: Dict[str, float] = {}
@@ -141,6 +148,20 @@ class HostState:
                 if s is None:
                     s = ev.get("expected_s")
                 self.comms_s_per_step = float(s or 0.0)
+            elif kind == "memory":
+                from bigdl_tpu.telemetry.memory import (
+                    live_peak_and_limit, pressured_device)
+
+                self.hbm_peak_bytes = int(ev.get("peak_bytes", 0) or 0)
+                live = ev.get("live")
+                budget = ev.get("hbm_limit_bytes")
+                peak, limit = live_peak_and_limit(live, budget)
+                if peak:
+                    self.hbm_live_bytes = peak
+                if limit:
+                    self.hbm_limit_bytes = limit
+                self._memory_pressured = \
+                    pressured_device(live, budget) is not None
             elif kind == "event":
                 if ev.get("name") == "checkpoint/saved":
                     self.ckpt_step = ev.get("step")
@@ -157,6 +178,14 @@ class HostState:
         idx = min(len(durs) - 1,
                   max(0, int(round(q / 100.0 * (len(durs) - 1)))))
         return durs[idx]
+
+    def memory_pressure(self) -> bool:
+        """True when any of this host's devices last reported a live
+        peak within ``memory.PRESSURE_FRACTION`` of its own allocator
+        limit — the step before RESOURCE_EXHAUSTED; the blame verdict
+        carries it as a note (judged per device in ``fold``, the same
+        rule the ``memory/pressure`` instant fires on)."""
+        return self._memory_pressured
 
     def components(self) -> Dict[str, float]:
         """Mean per-step seconds per blame component over the window
@@ -192,6 +221,10 @@ class HostState:
                            and self.last_ts is not None else 0.0),
                 "components_s": comp, **shares,
                 "comms_bytes": self.comms_bytes,
+                "hbm_peak_bytes": self.hbm_peak_bytes,
+                "hbm_live_bytes": self.hbm_live_bytes,
+                "hbm_limit_bytes": self.hbm_limit_bytes,
+                "memory_pressure": self.memory_pressure(),
                 "nonfinite_steps": self.nonfinite_steps,
                 "checkpoint_step": self.ckpt_step,
                 "checkpoint_age_s": (round(now - self.ckpt_ts, 3)
@@ -223,14 +256,22 @@ def blame(hosts: List[HostState]) -> Optional[Dict[str, Any]]:
 
     def verdict(h: HostState, cause: str, excess: float) -> Dict[str, Any]:
         last_steps = [x.last_step for x in active]
-        return {"laggard": h.process_index, "cause": cause,
-                "excess_s": round(excess, 6),
-                "lag_steps": max(last_steps) - min(last_steps),
-                "floor_s": round(floor, 6),
-                "components": {f"p{x.process_index}":
-                               {k: round(v, 6)
-                                for k, v in comp[x].items()}
-                               for x in active}}
+        out = {"laggard": h.process_index, "cause": cause,
+               "excess_s": round(excess, 6),
+               "lag_steps": max(last_steps) - min(last_steps),
+               "floor_s": round(floor, 6),
+               "components": {f"p{x.process_index}":
+                              {k: round(v, 6)
+                               for k, v in comp[x].items()}
+                              for x in active}}
+        # a host running within 5% of its HBM limit is one allocation
+        # away from RESOURCE_EXHAUSTED — allocator churn near the
+        # ceiling also SLOWS the host, so the verdict names it
+        pressured = [f"p{x.process_index}" for x in active
+                     if x.memory_pressure()]
+        if pressured:
+            out["memory_pressure"] = pressured
+        return out
 
     best: Optional[Tuple[HostState, str, float]] = None
     for h in active:
@@ -370,6 +411,14 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
     for p in sorted(hosts, key=lambda r: r["process_index"]):
         r = rich.get(f"p{p['process_index']}", {})
         age = r.get("age_s")
+        hbm = ""
+        if r.get("hbm_peak_bytes"):
+            hbm = f"hbm {r['hbm_peak_bytes'] / (1 << 30):.1f}G"
+            if r.get("hbm_limit_bytes"):
+                hbm += f"/{r['hbm_limit_bytes'] / (1 << 30):.1f}G"
+            hbm += "  "
+            if r.get("memory_pressure"):
+                hbm = hbm.rstrip() + "!  "
         lines.append(
             f"p{p['process_index']:<3} step {p['last_step']:<6} "
             f"age {age if age is not None else '?':>7}s  "
@@ -377,6 +426,7 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
             f"data {_pct(r.get('data_wait_share', 0.0))}  "
             f"comms {_pct(r.get('comms_share', 0.0))}  "
             f"ckpt {_pct(r.get('checkpoint_share', 0.0))}  "
+            f"{hbm}"
             f"nonfinite {p['nonfinite_steps']}"
             f"{'  ENDED' if r.get('ended') else ''}  ({p['path']})")
     lines.append(f"step lag (fastest - slowest last step): "
@@ -390,10 +440,15 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
         lines.append("step skew: n/a (no step index seen by >1 process)")
     verdict = view.get("blame")
     if verdict:
-        lines.append(
+        line = (
             f"skew blame: p{verdict['laggard']} — {verdict['cause']} "
             f"(+{verdict['excess_s'] * 1e3:.1f} ms/step over the best "
             f"host, floor {verdict['floor_s'] * 1e3:.1f} ms)")
+        if verdict.get("memory_pressure"):
+            line += (f"  [memory pressure: "
+                     f"{','.join(verdict['memory_pressure'])} within "
+                     f"5% of HBM limit]")
+        lines.append(line)
     else:
         lines.append("skew blame: none (fleet healthy or <2 active hosts)")
     return "\n".join(lines)
@@ -584,7 +639,11 @@ def fleet_openmetrics() -> List[str]:
                 ("bigdl_fleet_data_wait_share", "data_wait_share",
                  "data-wait share of step time per host"),
                 ("bigdl_fleet_comms_share", "comms_share",
-                 "comms share of step time per host")]
+                 "comms share of step time per host"),
+                ("bigdl_fleet_hbm_peak_bytes", "hbm_peak_bytes",
+                 "per-device compiled peak HBM per host"),
+                ("bigdl_fleet_hbm_live_bytes", "hbm_live_bytes",
+                 "live allocator peak bytes per host")]
     for metric, field, help_ in per_host:
         lines.append(f"# HELP {metric} {help_}")
         lines.append(f"# TYPE {metric} gauge")

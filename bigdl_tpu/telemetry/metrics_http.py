@@ -84,6 +84,10 @@ class MetricsSink:
         # per-collective comms attribution (kind "comms",
         # telemetry/comms.py): the latest per-step snapshot
         self.last_comms: Dict[str, Any] = {}
+        # per-step memory attribution (kind "memory",
+        # telemetry/memory.py): the latest compiled-peak + live
+        # allocator snapshot — tpu_watch's hbm= block
+        self.last_memory: Dict[str, Any] = {}
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -148,6 +152,19 @@ class MetricsSink:
                                     "by_axis", "expected_s",
                                     "measured_s", "program")
                                    if k in event}
+            elif kind == "memory":
+                from bigdl_tpu.telemetry.memory import live_peak_and_limit
+
+                mem = {k: event[k] for k in
+                       ("peak_bytes", "args_bytes", "temp_peak_bytes",
+                        "donated_bytes", "hbm_limit_bytes", "program")
+                       if k in event}
+                peak, limit = live_peak_and_limit(event.get("live"))
+                if peak:
+                    mem["live_bytes"] = peak
+                if limit:
+                    mem["limit_bytes"] = limit
+                self.last_memory = mem
 
     def flush(self) -> None:
         pass
@@ -183,7 +200,8 @@ class MetricsSink:
                     "serve_batches": self.serve_batches,
                     "serve_rows": self.serve_rows,
                     "last_serve": dict(self.last_serve),
-                    "comms": dict(self.last_comms)}
+                    "comms": dict(self.last_comms),
+                    "memory": dict(self.last_memory)}
 
     def openmetrics(self) -> str:
         """Prometheus/OpenMetrics exposition text of the current state."""
@@ -262,6 +280,18 @@ class MetricsSink:
                 sample("bigdl_comms_collectives", "gauge",
                        self.last_comms.get("count"),
                        "collective op count per compiled step")
+            if self.last_memory:
+                sample("bigdl_hbm_peak_bytes", "gauge",
+                       self.last_memory.get("peak_bytes"),
+                       "predicted per-device peak HBM of the compiled "
+                       "step")
+                sample("bigdl_hbm_live_bytes", "gauge",
+                       self.last_memory.get("live_bytes"),
+                       "live allocator peak bytes in use")
+                sample("bigdl_hbm_limit_bytes", "gauge",
+                       self.last_memory.get("limit_bytes")
+                       or self.last_memory.get("hbm_limit_bytes"),
+                       "per-device HBM limit")
             for name, count in sorted(self.events.items()):
                 sample(_metric_name(name, "bigdl_event_") + "_total",
                        "counter", count, f"instant events named {name}")

@@ -36,6 +36,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     gauges: Dict[str, Dict[str, float]] = {}
     facts: Dict[str, Any] = {}
     attribution: Optional[Dict[str, Any]] = None
+    memory: Optional[Dict[str, Any]] = None
     health: Dict[str, Any] = {"probes": 0, "nonfinite_steps": 0,
                               "events": {}, "last": {}}
     t0 = t1 = None
@@ -95,6 +96,9 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "attribution":
             attribution = {k: v for k, v in ev.items()
                            if k not in ("v", "ts", "pid", "tid", "kind")}
+        elif kind == "memory":
+            memory = {k: v for k, v in ev.items()
+                      if k not in ("v", "ts", "pid", "tid", "kind")}
 
     for row in stages.values():
         row["mean_s"] = row["total_s"] / row["n"] if row["n"] else 0.0
@@ -131,7 +135,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "compiles": compiles, "retraces": retraces,
             "events": instants, "counters": counters, "gauges": gauges,
             "device_facts": facts, "mfu": mfu, "health": health,
-            "attribution": attribution}
+            "attribution": attribution, "memory": memory}
 
 
 def _fmt_bytes(n: float) -> str:
@@ -266,6 +270,29 @@ def format_summary(summary: Dict[str, Any],
                          f"{r['flops']/1e9:9.3f} GF  "
                          f"{r['flops']/total*100:5.1f}%  "
                          f"{r.get('class', '')}")
+
+    memory = summary.get("memory")
+    if memory and memory.get("peak_bytes"):
+        lines.append("")
+        lines.append("-- memory (full table: telemetry attribute "
+                     "--memory) --")
+        lines.append(f"per-device peak   "
+                     f"{_fmt_bytes(memory['peak_bytes'])}  (args "
+                     f"{_fmt_bytes(memory.get('args_bytes', 0))} + "
+                     f"temp "
+                     f"{_fmt_bytes(memory.get('temp_peak_bytes', 0))})")
+        cats = memory.get("categories") or {}
+        for key, label in (("params", "params"),
+                           ("opt_state", "optimizer state"),
+                           ("activations_at_peak", "activations@peak"),
+                           ("workspace_at_peak", "workspace@peak"),
+                           ("donated", "donated (in place)")):
+            if cats.get(key):
+                lines.append(f"{label:<17} {_fmt_bytes(cats[key])}")
+        if memory.get("hbm_limit_bytes"):
+            lines.append(f"hbm budget        "
+                         f"{_fmt_bytes(memory['hbm_limit_bytes'])}"
+                         f"/device")
 
     health = summary.get("health") or {}
     if health.get("probes"):

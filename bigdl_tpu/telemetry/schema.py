@@ -60,6 +60,13 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # expected_s / measured_s travel as extra fields — the raw material
     # for `telemetry diff`'s comms_bytes/comms_s and fleet skew blame
     "comms": {"count": (int,), "bytes": _NUM},
+    # per-step memory attribution (telemetry/memory.py): peak_bytes =
+    # predicted per-device peak HBM (args + live-buffer-timeline temp
+    # peak off the scheduled post-opt HLO); categories / rows / largest
+    # / live (allocator stats per device) / hbm_limit_bytes travel as
+    # extra fields — the raw material for `telemetry diff`'s
+    # peak_hbm_bytes gate and the fleet memory-pressure note
+    "memory": {"peak_bytes": _NUM},
 }
 
 _BASE: Dict[str, tuple] = {"v": (int,), "ts": _NUM, "pid": (int,),
@@ -106,6 +113,11 @@ STREAM_NAMES = frozenset({
     # excess as gauges, and a rate-limited skew-blame instant whenever
     # the fleet diverges — the PR-7 watchdog's flight dump carries them
     "cluster/skew", "fleet/lag_steps", "fleet/skew_s",
+    # memory observability (telemetry/memory.py): one rate-limited
+    # instant when a device's live allocator peak crosses 95% of its
+    # HBM limit — the step before RESOURCE_EXHAUSTED, surfaced so the
+    # fleet blame and tpu_watch can call it BEFORE the crash
+    "memory/pressure",
     # health findings (telemetry/health.py detectors + policy)
     "health/nonfinite", "health/skip", "health/loss_spike",
     "health/plateau", "health/grad_explosion", "health/halt",

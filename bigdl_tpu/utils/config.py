@@ -29,6 +29,7 @@ test (or an embedding application) can inject overrides with
 | module_scopes          | BIGDL_SCOPES                | jax.named_scope module paths in compiled HLO (default on; off disables attribution) |
 | telemetry_attribution  | BIGDL_ATTRIBUTION           | emit per-module cost-attribution events (one re-lower + HLO parse per step object) |
 | telemetry_comms        | BIGDL_COMMS                 | per-collective comms events (telemetry/comms.py): off / auto (sharded multi-device steps only) / on — one extra local XLA compile per step object |
+| telemetry_memory       | BIGDL_MEMORY                | per-step memory events (telemetry/memory.py): off / auto (multi-device meshes only) / on — shares the comms compile, so on a sharded step the event is a text parse |
 | fleet_interval         | BIGDL_FLEET_INTERVAL        | coordinator fleet-watcher poll seconds (telemetry/fleet.py; 0 = off; active only on multi-process runs) |
 | flight_events          | BIGDL_FLIGHT                | crash flight-recorder ring capacity in events (0 = off) |
 | profile_on_health      | BIGDL_PROFILE_ON_HEALTH     | arm a one-shot profiler capture (dir) when the health policy first escalates |
@@ -65,6 +66,7 @@ time inside jitted-program construction):
 | BIGDL_COORDINATOR_TIMEOUT | Engine._init_distributed bounded jax.distributed join (s, default 300; 0 = unbounded) |
 | BIGDL_PEAK_FLOPS      | telemetry.device MFU denominator override (FLOP/s per device) |
 | BIGDL_PEAK_BW         | telemetry.device comms-bandwidth denominator override (interconnect bytes/s per device) |
+| BIGDL_HBM_GB          | telemetry.memory per-device HBM budget override in GiB (fit estimator + OOM forensics; default: the per-chip table, else the live allocator limit) |
 | JAX_PLATFORMS         | honored over externally-registered PJRT plugins via honor_platform_request |
 """
 
@@ -129,6 +131,11 @@ class BigDLConfig:
     # object (collectives only exist post-SPMD-partitioning, and jit's
     # executable cache is not reachable from the lowered program).
     telemetry_comms: str = "auto"
+    # per-step memory events (telemetry/memory.py): off | auto | on.
+    # auto = only for steps whose mesh spans >1 device (the case where
+    # per-device HBM is the scaling question and where the comms event
+    # already pays the post-SPMD compile the walker shares).
+    telemetry_memory: str = "auto"
     # coordinator-side live fleet watcher poll seconds (0 disables)
     fleet_interval: float = 2.0
     # crash flight recorder: event-ring capacity (0 disables)
@@ -201,6 +208,8 @@ class BigDLConfig:
             telemetry_attribution=_truthy(env.get("BIGDL_ATTRIBUTION")),
             telemetry_comms=(env.get("BIGDL_COMMS")
                              or "auto").strip().lower(),
+            telemetry_memory=(env.get("BIGDL_MEMORY")
+                              or "auto").strip().lower(),
             fleet_interval=_float("BIGDL_FLEET_INTERVAL", 2.0),
             flight_events=_int("BIGDL_FLIGHT", 2048),
             profile_on_health=env.get("BIGDL_PROFILE_ON_HEALTH") or None,

@@ -38,7 +38,12 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           # walker the bytes-moved diff gate reads, and the live
           # cross-host aggregator behind /status.fleet + skew blame
           "bigdl_tpu/telemetry/comms.py",
-          "bigdl_tpu/telemetry/fleet.py"]
+          "bigdl_tpu/telemetry/fleet.py",
+          # memory observability (ISSUE 11): the HBM walker behind the
+          # peak_hbm_bytes diff gate, the fit estimator, and the
+          # OOM-forensics evidence — a silent drop reverts device OOMs
+          # to a bare RESOURCE_EXHAUSTED
+          "bigdl_tpu/telemetry/memory.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

@@ -44,6 +44,11 @@ PINNED_MODULES = [
     # log merges with no skew blame
     "bigdl_tpu/telemetry/comms.py",
     "bigdl_tpu/telemetry/fleet.py",
+    # memory observability (ISSUE 11): losing memory.py blinds the
+    # peak_hbm_bytes gate (the ZeRO "optimizer HBM dropped" proof), the
+    # fit estimator, and OOM forensics — device OOMs revert to a bare
+    # RESOURCE_EXHAUSTED with no resident-buffer evidence
+    "bigdl_tpu/telemetry/memory.py",
     # the kernel library (PR 6): losing any of these silently reverts
     # hot paths to unfused XLA chains and wrong-by-autodiff VJPs
     "bigdl_tpu/ops/dispatch.py",

@@ -132,6 +132,23 @@ comms = st.get("comms") or {}
 if comms.get("bytes"):
     line += (f" comms={comms['bytes'] / 1e6:.1f}MB/step"
              f"@{comms.get('count', '?')}coll")
+# memory attribution (telemetry/memory.py): live allocator vs limit +
+# the compiled step's predicted per-device peak — the babysitter sees a
+# run creeping toward RESOURCE_EXHAUSTED before it dies
+mem = st.get("memory") or {}
+if mem.get("peak_bytes"):
+    g = 1 << 30
+    live = mem.get("live_bytes")
+    limit = mem.get("limit_bytes") or mem.get("hbm_limit_bytes")
+    if live is not None and limit:
+        line += (f" hbm={live / g:.1f}G/{limit / g:.1f}G"
+                 f" peak={mem['peak_bytes'] / g:.1f}G")
+        # 0.95 == telemetry.memory.PRESSURE_FRACTION (stdlib-only
+        # snippet; limit_bytes here is already the allocator's own)
+        if live >= 0.95 * limit:
+            line += "!PRESSURE"
+    else:
+        line += f" hbm_peak={mem['peak_bytes'] / g:.2f}G"
 # fleet watcher (telemetry/fleet.py, coordinator only): host count,
 # completed-step lag, and the skew-blame verdict — "one host is slow,
 # whose fault?" answered on one line
