@@ -117,9 +117,25 @@ class LocalDataSet(AbstractDataSet):
 
 class DistributedDataSet(LocalDataSet):
     """Multi-host SPMD dataset (``dataset/DataSet.scala:164`` capability):
-    each host process reads only its shard of the records, so the global
+    each host process feeds only its share of every global batch, so the
     batch assembled across processes covers the whole dataset — the
-    reference's one-cached-partition-per-node layout."""
+    reference's one-cached-partition-per-node layout.
+
+    Epoch order is a WIDTH-INVARIANT global permutation (elastic
+    recovery, docs/fault_tolerance.md): the epoch-``e`` order over
+    GLOBAL record indices is a pure function of ``(shuffle seed, e,
+    global size)`` — independent of ``num_shards`` — and process ``p``
+    feeds the positions ``p, p+N, p+2N, ...`` of that global order.
+    Any batch size divisible by ``N`` then assembles the SAME global
+    batch contents at every width, so a checkpoint written by a
+    4-process run resumes on 2 (or 8) processes onto the exact next
+    global batch, not a resharded-differently epoch.  (The per-shard
+    permutation this replaces made epoch>1 batch composition a function
+    of the width — topology-portable checkpoints could restore the
+    state but not the data trajectory.)  The full record list rides
+    along on every host to make any position addressable; pod-scale
+    datasets that cannot afford that should stream through
+    ``DataSet.generator`` with their own sharding."""
 
     def __init__(self, data, num_shards: int = 1, shard_index: int = 0,
                  transformers: Optional[List[Transformer]] = None):
@@ -127,10 +143,52 @@ class DistributedDataSet(LocalDataSet):
         self.num_shards, self.shard_index = num_shards, shard_index
         shard = data[shard_index::num_shards] if num_shards > 1 else data
         super().__init__(shard, transformers)
+        self._full = data
         self._global_size = len(data)
+        self._global_perm = np.arange(len(data))
 
     def global_size(self) -> int:
         return self._global_size
+
+    def shuffle(self):
+        # every process draws from the same shared-seed RNG stream, so
+        # the global base permutation stays SPMD-consistent
+        self._global_perm = RNG.permutation(self._global_size)
+        return self
+
+    def _global_perm_for_epoch(self, epoch: int) -> np.ndarray:
+        if epoch <= 0:
+            return self._global_perm
+        gen = np.random.Generator(np.random.Philox(
+            key=np.array([self._shuffle_seed, epoch], dtype=np.uint64)))
+        return self._global_perm[gen.permutation(self._global_size)]
+
+    def _raw_iter(self, train: bool) -> Iterator:
+        if not train:
+            yield from super()._raw_iter(train)
+            return
+        size = self._global_size
+        if size == 0:
+            return
+        # stride the CONCATENATED epoch stream, not each epoch
+        # separately: process p yields stream positions p, p+N, p+2N...
+        # of the infinite epoch_e ++ epoch_{e+1} ++ ... sequence.  With
+        # a per-epoch stride restart, a global size not divisible by N
+        # gives processes unequal epoch lengths and the assembled batch
+        # contents diverge by width from the first epoch boundary; the
+        # continued stride keeps every batch window width-invariant
+        # (and is identical to the per-epoch stride when N | size).
+        n, p = self.num_shards, self.shard_index
+        pos = p
+        g = None
+        g_epoch: Optional[int] = None
+        while True:
+            epoch = self._epoch + pos // size
+            if g_epoch != epoch:
+                g = self._global_perm_for_epoch(epoch)
+                g_epoch = epoch
+            yield self._full[g[pos % size]]
+            pos += n
 
     def transform(self, transformer: Transformer) -> "DistributedDataSet":
         ds = DistributedDataSet.__new__(DistributedDataSet)
@@ -139,7 +197,9 @@ class DistributedDataSet(LocalDataSet):
         ds._epoch = self._epoch
         ds._shuffle_seed = self._shuffle_seed
         ds.num_shards, ds.shard_index = self.num_shards, self.shard_index
+        ds._full = self._full
         ds._global_size = self._global_size
+        ds._global_perm = self._global_perm
         ds._transformers = self._transformers + [transformer]
         return ds
 

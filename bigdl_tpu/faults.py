@@ -37,6 +37,14 @@ Plan syntax — comma-separated ``kind[@step][:pP]`` specs::
 | ``peer_wedge``| inside the iteration (no straggler rescue needed) | peer-heartbeat deadline |
 | ``commit_crash``| cluster commit barrier (post-write, pre-ack) | manifest-capped restore (no mixed steps) |
 
+Permanent capacity loss is modeled by KEEPING the plan across supervised
+restarts (``supervise --keep-faults``): a ``peer_kill@step:pP`` then
+fires in every incarnation — the host "never comes back" — which is the
+signature the capacity-aware supervisor (``supervise --min-n``,
+``parallel/cluster.py``) degrades the cluster width on.  A ``:pP``
+selector for a process index outside the degraded width simply never
+matches again — an absent host cannot fault.
+
 Determinism: the spec is positional (step numbers, not probabilities)
 and the only random choices (which bytes ``torn_ckpt`` flips) come from
 a Philox generator seeded by ``BIGDL_FAULTS_SEED`` — the same plan +

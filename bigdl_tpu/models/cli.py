@@ -213,11 +213,17 @@ def cmd_train(args) -> None:
     if getattr(o, "preempted", False):
         # graceful SIGTERM/SIGINT: the final checkpoint is committed;
         # exit 0 — rerunning this exact command resumes mid-epoch
-        # (docs/fault_tolerance.md)
+        # (docs/fault_tolerance.md).  The hint names the topology the
+        # checkpoint can restore onto (it is topology-PORTABLE — a
+        # shrunk slice resumes on fewer chips) and the capacity-aware
+        # supervise recipe, not just "re-run me".
         print(f"preempted at iteration {o.state['neval']} "
               f"(epoch {o.state['epoch']}); checkpoint committed"
               + (f" under {args.checkpoint}" if args.checkpoint else "")
               + " — rerun to resume")
+        hint = o.resume_hint()
+        if hint:
+            print(hint)
         return
     res = optim.Evaluator(trained, batch_size=args.batch_size).evaluate(
         val_samples, val_methods)
@@ -420,7 +426,8 @@ def cmd_supervise(args) -> None:
                      max_restarts=args.max_restarts,
                      cluster_dir=args.cluster_dir,
                      keep_faults=args.keep_faults,
-                     log_dir=args.log_dir)
+                     log_dir=args.log_dir,
+                     min_nprocs=args.min_n)
     raise SystemExit(sup.run())
 
 
@@ -576,6 +583,13 @@ def main(argv=None) -> None:
                     help="cluster size (one jax process per slot)")
     sv.add_argument("--max-restarts", type=int, default=5,
                     help="full-cluster restarts before giving up")
+    sv.add_argument("--min-n", type=int, default=None, metavar="M",
+                    help="capacity-aware floor: when restart attempts "
+                         "at -n keep dying on the same missing peer, "
+                         "relaunch DEGRADED at M processes instead of "
+                         "burning the restart budget (the topology-"
+                         "portable checkpoint reshards on load; grows "
+                         "back to -n on the next full-capacity restart)")
     sv.add_argument("--cluster-dir", default=None,
                     help="shared heartbeat/commit dir (default: a fresh "
                          "temp dir; must be shared storage on real "

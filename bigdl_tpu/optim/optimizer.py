@@ -44,6 +44,7 @@ from bigdl_tpu.parallel.train_step import EvalStep, TrainStep
 from bigdl_tpu.telemetry.memory import MemoryExhaustedError
 from bigdl_tpu.telemetry.health import (HealthError, HealthPolicy,
                                         probe_stats)
+from bigdl_tpu.utils.ckpt_topology import TopologyMismatchError
 from bigdl_tpu.utils import file as File
 from bigdl_tpu.utils.config import get_config
 from bigdl_tpu.utils.engine import Engine
@@ -478,10 +479,15 @@ class Optimizer:
                     # would strand the whole cluster
                     cap = (svc.restore_cap(self._ckpt_dir)
                            if svc is not None else None)
-                    for p in sharded_ckpt.prune_old(self._ckpt_dir,
-                                                    self._ckpt_keep,
-                                                    trusted=dest,
-                                                    keep_step=cap):
+                    for p in sharded_ckpt.prune_old(
+                            self._ckpt_dir, self._ckpt_keep,
+                            trusted=dest, keep_step=cap,
+                            # mixed-topology dirs: never delete the last
+                            # checkpoint restorable onto the CURRENT
+                            # width (docs/fault_tolerance.md "Elastic
+                            # recovery")
+                            restorable_fn=sharded_ckpt.restorable_onto_fn(
+                                self._mesh)):
                         log.info(f"[Checkpoint] pruned {p}")
                 log.info(f"[Checkpoint] saved sharded.{n} "
                          f"to {self._ckpt_dir}")
@@ -514,7 +520,7 @@ class Optimizer:
         # snapshot to bytes NOW (consistent state); the IO can overlap
         # with the next training iterations (BIGDL_ASYNC_CHECKPOINT)
         self._join_checkpoint_write()
-        from bigdl_tpu.utils import ckpt_digest
+        from bigdl_tpu.utils import ckpt_digest, ckpt_topology
 
         blobs = [(dumps(self.model, kind="module"),
                   os.path.join(self._ckpt_dir, f"model.{n}")),
@@ -523,10 +529,17 @@ class Optimizer:
         # content digests of the exact bytes being written, committed in
         # a meta marker AFTER the payload lands — restore verifies them
         # before loading, so a torn/bit-rotted pair is quarantined, not
-        # silently deserialized
+        # silently deserialized.  The topology record rides along (own
+        # digest): BTPU state is gathered whole-model — portable by
+        # construction — but a restore onto a different width still
+        # announces the reshard and the resume hint still names the
+        # widths the sharded layout would accept.
+        topo = ckpt_topology.topology_of(step)
         meta = {"neval": n,
                 "digests": {os.path.basename(p): ckpt_digest.digest_bytes(b)
-                            for b, p in blobs}}
+                            for b, p in blobs},
+                "topology": topo,
+                "topology_digest": ckpt_topology.digest(topo)}
         meta_path = os.path.join(self._ckpt_dir, f"ckptmeta.{n}.json")
 
         def write():
@@ -642,10 +655,40 @@ class Optimizer:
             log.info(f"[Recovery] cluster manifest caps restore at "
                      f"step {cap} under {d}")
         if self._ckpt_backend == "sharded":
-            from bigdl_tpu.utils.sharded_ckpt import latest_verified_step_dir
+            from bigdl_tpu.utils.sharded_ckpt import (
+                latest_verified_step_dir, restorable_onto_fn)
 
-            latest = latest_verified_step_dir(d, max_step=cap)
+            # elastic walk: a verified step whose recorded topology the
+            # CURRENT mesh cannot take is skipped (not quarantined) in
+            # favor of the newest one this width can restore.  The walk
+            # probes restorability only on VERIFIED candidates, so a
+            # wrapper recording rejections distinguishes "nothing to
+            # resume" from "none restores at this width" without
+            # re-hashing every dir a second time.
+            base_fn = restorable_onto_fn(self._mesh)
+            unrestorable: List[str] = []
+
+            def probing_fn(p: str) -> bool:
+                ok = base_fn(p)
+                if not ok:
+                    unrestorable.append(p)
+                return ok
+
+            latest = latest_verified_step_dir(d, max_step=cap,
+                                              restorable_fn=probing_fn)
             if latest is None:
+                if unrestorable:
+                    # checkpoints exist but NONE restores at this width
+                    # — silently restarting from step 0 would throw
+                    # away all progress behind a log line (e.g. a
+                    # --min-n width outside the restorable sizes)
+                    raise TopologyMismatchError(
+                        f"checkpoints exist under {d} "
+                        f"({len(unrestorable)} verified) but none is "
+                        f"restorable onto the current mesh — pick a "
+                        f"width from the checkpoint's restorable sizes "
+                        f"(the preemption resume hint prints them) or "
+                        f"resume at the writing width")
                 return False
             # applied onto the fresh TrainStep inside _optimize_once (the
             # restore needs the live mesh placement, which the step owns)
@@ -677,27 +720,57 @@ class Optimizer:
             self._apply_driver_state(
                 self.optim_method.state.get("driver_state", {}))
             log.info(f"[Recovery] restored {mfile} and {ofile}")
+            self._announce_btpu_reshard(d, n)
             return True
         return False
 
-    def _btpu_verify(self, d: str, n: int) -> Tuple[bool, List[str]]:
-        """Digest check of the ``model.n``/``optimMethod.n`` pair against
-        its ``ckptmeta.n.json`` marker.  Pairs from before the digest
-        era (no marker) pass when both files exist — rejecting them
-        would strand every old checkpoint."""
+    def _announce_btpu_reshard(self, d: str, n: int) -> None:
+        """BTPU state is gathered whole-model — portable onto any width
+        by construction — but a restore whose recorded topology differs
+        from the live one is still a membership change the fleet view
+        and the flight recorder must see: announce it as a
+        ``cluster/reshard`` instant (docs/fault_tolerance.md "Elastic
+        recovery")."""
+        from bigdl_tpu.utils import ckpt_topology
+
+        topo = (self._btpu_meta(d, n) or {}).get("topology")
+        if not topo:
+            return
+        fields = ckpt_topology.reshard_fields(topo, self._mesh,
+                                              source="restore", step=n)
+        if fields is not None:
+            log.info(f"[Reshard] restoring a checkpoint "
+                     f"{ckpt_topology.describe(topo)} onto "
+                     f"{fields['to_processes']} process(es) / "
+                     f"{fields['to_devices']} device(s)")
+            telemetry.instant("cluster/reshard", **fields)
+
+    def _btpu_meta(self, d: str, n: int) -> Optional[Dict]:
         import json as _json
 
-        from bigdl_tpu.utils import ckpt_digest
-
         try:
-            meta = _json.loads(File.load(
+            return _json.loads(File.load(
                 File.join(d, f"ckptmeta.{n}.json")).decode())
         except (OSError, ValueError):
+            return None
+
+    def _btpu_verify(self, d: str, n: int) -> Tuple[bool, List[str]]:
+        """Digest check of the ``model.n``/``optimMethod.n`` pair against
+        its ``ckptmeta.n.json`` marker — the topology record (when
+        present) verifies against its own digest too.  Pairs from before
+        the digest era (no marker) pass when both files exist —
+        rejecting them would strand every old checkpoint."""
+        from bigdl_tpu.utils import ckpt_digest, ckpt_topology
+
+        meta = self._btpu_meta(d, n)
+        if meta is None:
             both = all(File.exists(File.join(d, f"{p}.{n}"))
                        for p in ("model", "optimMethod"))
             return both, ([] if both else
                           [f"incomplete pair at {n} (no meta marker)"])
-        problems = ckpt_digest.verify_digests(d, meta.get("digests") or {})
+        problems = list(ckpt_topology.verify_digest(meta))
+        problems.extend(
+            ckpt_digest.verify_digests(d, meta.get("digests") or {}))
         return not problems, problems
 
     def _quarantine_btpu(self, d: str, n: int, problems: List[str]):
@@ -737,6 +810,60 @@ class Optimizer:
                 # only host-random reproducibility degrades
                 log.warning(f"[Recovery] could not restore RNG state "
                             f"({type(e).__name__}: {e})")
+
+    def resume_hint(self) -> Optional[str]:
+        """Operator-facing resume guidance after a preemption: the
+        topology the newest checkpoint was written under, the widths it
+        can restore onto (topology-portable — docs/fault_tolerance.md
+        "Elastic recovery"), and the capacity-aware ``supervise
+        --min-n`` recipe.  None when no checkpoint/topology exists."""
+        from bigdl_tpu.utils import ckpt_topology
+
+        d = self._checkpoint_dir()
+        if d is None:
+            return None
+        topo = None
+        try:
+            if self._ckpt_backend == "sharded":
+                from bigdl_tpu.utils.sharded_ckpt import (latest_step_dir,
+                                                          read_topology)
+
+                latest = latest_step_dir(d)
+                if latest:
+                    topo = read_topology(latest)
+            else:
+                nums = [int(m.group(1)) for f in File.listdir(d)
+                        if (m := re.match(r"ckptmeta\.(\d+)\.json$", f))]
+                if nums:
+                    topo = (self._btpu_meta(d, max(nums))
+                            or {}).get("topology")
+        except OSError:
+            return None
+        if not topo:
+            return None
+        lines = [f"checkpoint topology: {ckpt_topology.describe(topo)}"]
+        nproc = int(topo.get("process_count") or 1)
+        if nproc > 1:
+            # suggest a width the checkpoint can actually take: the
+            # restorable sizes are MESH sizes, so a candidate process
+            # count m maps to m × devices-per-process; prefer the
+            # largest restorable width at or below half capacity
+            sizes = ckpt_topology.restorable_mesh_sizes(topo)
+            dpp = max(1, int(topo.get("device_count") or nproc) // nproc)
+            cands = [m for m in range(1, nproc)
+                     if sizes is None or m * dpp in sizes]
+            if cands:
+                min_n = max([m for m in cands if m <= nproc // 2]
+                            or cands)
+                lines.append(
+                    f"shrunk slice? resume on fewer chips: "
+                    f"python -m bigdl_tpu.models.cli supervise "
+                    f"-n {nproc} --min-n {min_n} -- <your train "
+                    f"command> — restart attempts that keep losing "
+                    f"the same peer relaunch at {min_n} process(es); "
+                    f"this checkpoint reshards onto the smaller mesh "
+                    f"on load")
+        return "\n".join(lines)
 
     def _resume_sources(self) -> List[str]:
         """Candidate directories a fresh ``optimize()`` may auto-resume
@@ -966,6 +1093,12 @@ class Optimizer:
                     # only delays the verdict.  The evidence (largest
                     # buffers, categories, live-vs-limit) was flight-
                     # dumped at the raise site (telemetry/memory.py).
+                    raise
+                except TopologyMismatchError:
+                    # likewise deterministic: the checkpoint cannot
+                    # restore onto this mesh, and a retry replays the
+                    # same verdict — surface it (pick a restorable
+                    # width) instead of burning the budget
                     raise
                 except Exception as e:  # noqa: BLE001 — retry loop parity
                     now = time.time()

@@ -590,7 +590,19 @@ class Supervisor:
     Restarts are bounded (``max_restarts``) with exponential backoff
     reusing ``BIGDL_RETRY_BACKOFF`` semantics; SIGTERM to the
     supervisor propagates to the children (whose grace handlers commit
-    final checkpoints) and ends the loop cleanly."""
+    final checkpoints) and ends the loop cleanly.
+
+    **Capacity-aware recovery** (``--min-n`` /  ``min_nprocs``): when
+    two consecutive restart attempts at the declared width die on the
+    SAME casualty slot (exit-history signature: one slot SIGKILLed or
+    crashed while the survivors abort 43/SIGABRT), the peer is presumed
+    gone and the next incarnation launches DEGRADED at ``min_nprocs``
+    instead of burning the restart budget waiting for it — the
+    topology-portable checkpoint (docs/fault_tolerance.md "Elastic
+    recovery") reshards onto the smaller mesh on load, and the workers
+    announce the membership change with a ``cluster/reshard`` instant
+    the fleet view folds in.  A failure at degraded width retries the
+    full ``-n`` first (capacity may have returned)."""
 
     def __init__(self, nprocs: int, command: Sequence[str],
                  max_restarts: int = 5,
@@ -598,12 +610,31 @@ class Supervisor:
                  keep_faults: bool = False,
                  settle_grace: Optional[float] = None,
                  env: Optional[Dict[str, str]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 min_nprocs: Optional[int] = None):
         if nprocs < 1:
             raise ValueError("nprocs must be >= 1")
         if not command:
             raise ValueError("supervise needs a worker command")
         self.nprocs = int(nprocs)
+        if min_nprocs is not None and not 1 <= int(min_nprocs) <= nprocs:
+            raise ValueError(f"min_nprocs must be in [1, {nprocs}]")
+        #: capacity-aware floor (``--min-n``): when consecutive restart
+        #: attempts at the declared width keep losing the SAME peer
+        #: slot, the cluster relaunches degraded at this width instead
+        #: of burning the restart budget on a slice that isn't coming
+        #: back; None = fixed-width supervision (pre-elastic behavior)
+        self.min_nprocs = int(min_nprocs) if min_nprocs is not None \
+            else None
+        #: the operator-declared full width; ``nprocs`` is the CURRENT
+        #: width and shrinks/grows between incarnations
+        self.declared_nprocs = int(nprocs)
+        #: width of each launched incarnation, oldest first
+        self.width_history: List[int] = []
+        self._last_casualties: frozenset = frozenset()
+        #: slots the supervisor's drain escalation terminated this
+        #: incarnation (reset per launch) — excluded from casualties
+        self._drained_slots: set = set()
         self.command = list(command)
         self.max_restarts = int(max_restarts)
         self.keep_faults = keep_faults
@@ -650,6 +681,7 @@ class Supervisor:
         env = dict(self.base_env)
         env.update(BIGDL_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
                    BIGDL_NUM_PROCESSES=str(self.nprocs),
+                   BIGDL_SUPERVISOR_DECLARED_N=str(self.declared_nprocs),
                    BIGDL_PROCESS_ID=str(pid_index),
                    BIGDL_CLUSTER_DIR=os.path.join(
                        self.cluster_dir, f"inc{self.incarnation}"),
@@ -663,6 +695,8 @@ class Supervisor:
 
     def _launch(self) -> None:
         port = _free_port()
+        self.width_history.append(self.nprocs)
+        self._drained_slots = set()
         os.makedirs(os.path.join(self.cluster_dir,
                                  f"inc{self.incarnation}"), exist_ok=True)
         self._log_files = []
@@ -694,6 +728,12 @@ class Supervisor:
         """SIGTERM the cluster, grant ``grace`` for clean exits (grace
         handlers commit final checkpoints), SIGKILL stragglers — a
         process blocked in a dead collective never sees the SIGTERM."""
+        # slots still alive HERE exit by the supervisor's own escalation
+        # — their SIGTERM/SIGKILL codes are a reaction the supervisor
+        # caused, never the casualty that seeded the failure (the
+        # --min-n signature must not blame a drained survivor)
+        self._drained_slots.update(
+            i for i, p in enumerate(self._procs) if p.poll() is None)
         self._signal_all(signal.SIGTERM)
         deadline = time.time() + grace
         while any(p.poll() is None for p in self._procs) \
@@ -759,6 +799,76 @@ class Supervisor:
 
         return retry_backoff_s(self.restarts)
 
+    # -- capacity-aware width (docs/fault_tolerance.md "Elastic recovery") ---
+    def _casualties(self, codes: Sequence[int]) -> frozenset:
+        """Slot indices that SEEDED the incarnation's failure: exits
+        that are neither clean (0), a watchdog peer-loss abort
+        (:data:`EXIT_PEER_LOST`), the jax runtime SIGABRTing a survivor
+        when the first abort took the coordinator down, nor a slot the
+        supervisor's own drain escalation terminated.  Those exits are
+        all REACTIONS to a loss; the casualty is the loss itself."""
+        drained = getattr(self, "_drained_slots", set())
+        return frozenset(
+            i for i, c in enumerate(codes)
+            if i not in drained
+            and c not in (0, EXIT_PEER_LOST) and c != -signal.SIGABRT)
+
+    def _plan_width(self, codes: Sequence[int]) -> None:
+        """Pick the next incarnation's width after a failed one.
+
+        Fixed-width (no ``min_nprocs``): nothing to decide.  Elastic:
+        when two consecutive incarnations at the DECLARED width die on
+        the same casualty slot (the peer isn't coming back — a
+        SIGKILLed host, revoked capacity), relaunch degraded at
+        ``min_nprocs`` instead of burning the restart budget; the
+        topology-portable checkpoint reshards onto the smaller mesh on
+        load.  A failure at degraded width grows back to the declared
+        width first — capacity may have returned, and a stale casualty
+        verdict must not pin the cluster small forever."""
+        from bigdl_tpu import telemetry
+
+        if self.min_nprocs is None:
+            return
+        cas = self._casualties(codes)
+        if self.nprocs < self.declared_nprocs:
+            log.warning(
+                f"[Supervisor] degraded incarnation "
+                f"({self.nprocs}/{self.declared_nprocs}) died too; "
+                f"retrying at full capacity -n {self.declared_nprocs}")
+            telemetry.instant("cluster/reshard", source="supervisor",
+                              from_n=self.nprocs,
+                              to_n=self.declared_nprocs,
+                              declared_n=self.declared_nprocs,
+                              incarnation=self.incarnation,
+                              reason="grow_back")
+            self.nprocs = self.declared_nprocs
+            self._last_casualties = frozenset()
+            return
+        # INTERSECTION, not equality: which SURVIVOR reacts how is a
+        # race (one may exit 43 via its watchdog, another may lose the
+        # gloo socket first and exhaust its retry budget with a generic
+        # nonzero exit, polluting the casualty set differently each
+        # round) — the signature of a host that isn't coming back is a
+        # slot that shows up as a casualty in BOTH consecutive rounds
+        persistent = cas & self._last_casualties
+        if persistent and self.min_nprocs < self.nprocs:
+            missing = ",".join(f"p{i}" for i in sorted(persistent))
+            log.warning(
+                f"[Supervisor] restart attempts at width {self.nprocs} "
+                f"keep dying on the same peer slot(s) {missing}; "
+                f"relaunching DEGRADED at --min-n {self.min_nprocs} — "
+                f"the topology-portable checkpoint reshards on load")
+            telemetry.instant("cluster/reshard", source="supervisor",
+                              from_n=self.nprocs, to_n=self.min_nprocs,
+                              declared_n=self.declared_nprocs,
+                              missing=sorted(persistent),
+                              incarnation=self.incarnation,
+                              reason="capacity_loss")
+            self.nprocs = self.min_nprocs
+            self._last_casualties = frozenset()
+            return
+        self._last_casualties = cas
+
     def run(self) -> int:
         """The supervision loop; returns the supervisor's exit code
         (0 = the cluster completed, or was stopped by signal after a
@@ -778,8 +888,12 @@ class Supervisor:
                                 f"exits {summary}")
                     return 0
                 if all(c == 0 for c in codes):
+                    degraded = ("" if self.nprocs == self.declared_nprocs
+                                else f" at DEGRADED width {self.nprocs}/"
+                                     f"{self.declared_nprocs}")
                     log.info(f"[Supervisor] cluster completed cleanly "
-                             f"after {self.restarts} restart(s)")
+                             f"after {self.restarts} restart(s)"
+                             f"{degraded}")
                     return 0
                 self.restarts += 1
                 if self.restarts > self.max_restarts:
@@ -787,17 +901,24 @@ class Supervisor:
                               f"({self.max_restarts}); final exits "
                               f"{summary}")
                     return 1
+                # capacity-aware width for the NEXT incarnation: shrink
+                # to --min-n when the same peer keeps dying, grow back
+                # to -n after a degraded-width failure
+                self._plan_width(codes)
                 backoff = self._backoff()
                 telemetry.instant("cluster/restart",
                                   incarnation=self.incarnation,
                                   restart=self.restarts,
                                   budget=self.max_restarts,
+                                  width=self.nprocs,
+                                  declared_n=self.declared_nprocs,
                                   exits=summary,
                                   backoff_s=round(backoff, 3))
                 log.warning(f"[Supervisor] incarnation "
                             f"{self.incarnation} died ({summary}); "
                             f"restart {self.restarts}/"
-                            f"{self.max_restarts} after "
+                            f"{self.max_restarts} at width "
+                            f"{self.nprocs} after "
                             f"{backoff:.2f}s — resuming from the last "
                             f"cluster-consistent checkpoint")
                 # interruptible: a SIGTERM during backoff ends the loop

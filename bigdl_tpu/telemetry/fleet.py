@@ -50,6 +50,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["HostState", "FleetWatcher", "fleet_view", "blame",
+           "fleet_width", "apply_topology",
            "format_fleet_view", "fleet_openmetrics", "main",
            "WINDOW_STEPS", "SKEW_LAG_STEPS", "SKEW_MIN_EXCESS_S",
            "SKEW_REL_EXCESS"]
@@ -78,8 +79,15 @@ class HostState:
     def __init__(self, path: str):
         self.path = path
         self.process_index: Optional[int] = None
+        self.process_count: Optional[int] = None  # run_start meta width
         self.run_ts: Optional[float] = None   # run_start ts = run id
         self.meta: Dict[str, Any] = {}
+        # latest cluster/reshard instant seen in THIS log (elastic
+        # recovery, docs/fault_tolerance.md): the fleet folds these so
+        # a host absent because the cluster legitimately shrank is
+        # marked departed, never blamed `stalled`
+        self.reshard: Optional[Dict[str, Any]] = None
+        self.departed = False  # recomputed by apply_topology()
         self.n_steps = 0
         self.last_step = 0
         self.last_step_ts: Optional[float] = None
@@ -120,6 +128,9 @@ class HostState:
                     pidx = self.meta.get("process_index")
                     if isinstance(pidx, int):
                         self.process_index = pidx
+                pcount = self.meta.get("process_count")
+                if isinstance(pcount, int):
+                    self.process_count = pcount
             elif kind == "span_end":
                 # blame components read from the host's own spans;
                 # validation deliberately rides the compute residual
@@ -167,6 +178,17 @@ class HostState:
                     self.ckpt_step = ev.get("step")
                     self.ckpt_ts = ts if isinstance(ts, (int, float)) \
                         else self.ckpt_ts
+                elif ev.get("name") == "cluster/reshard":
+                    rec = {"ts": ts if isinstance(ts, (int, float))
+                           else 0.0,
+                           "source": ev.get("source"),
+                           "to": ev.get("to_processes", ev.get("to_n")),
+                           "from": ev.get("from_processes",
+                                          ev.get("from_n")),
+                           "declared": ev.get("declared_n")}
+                    if self.reshard is None \
+                            or rec["ts"] >= self.reshard["ts"]:
+                        self.reshard = rec
             elif kind == "run_end":
                 self.ended = True
 
@@ -229,7 +251,49 @@ class HostState:
                 "checkpoint_step": self.ckpt_step,
                 "checkpoint_age_s": (round(now - self.ckpt_ts, 3)
                                      if self.ckpt_ts else None),
+                "departed": self.departed,
                 "ended": self.ended}
+
+
+# -- elastic topology (docs/fault_tolerance.md "Elastic recovery") ------------
+def fleet_width(states: List[HostState]) -> Optional[Dict[str, Any]]:
+    """The fleet's CURRENT vs DECLARED width from the newest
+    ``cluster/reshard`` instant across the kept logs, or None (no
+    reshard ever announced — the run_start widths are authoritative)."""
+    best: Optional[Dict[str, Any]] = None
+    for st in states:
+        r = st.reshard
+        if r and isinstance(r.get("to"), int) \
+                and (best is None or r["ts"] > best["ts"]):
+            best = r
+    if best is None:
+        return None
+    declared = best.get("declared")
+    if not isinstance(declared, int):
+        declared = max((st.process_count or 0 for st in states),
+                       default=0) or None
+    return {"current": int(best["to"]), "declared": declared,
+            "ts": best["ts"], "source": best.get("source")}
+
+
+def apply_topology(states: List[HostState]) -> Optional[Dict[str, Any]]:
+    """Fold topology changes into the per-host states: a host whose
+    process index falls outside the current width and whose stepping
+    stopped at/before the reshard is DEPARTED — the cluster
+    legitimately shrank around it, so the blame verdict must not call
+    it ``stalled`` forever.  Recomputes every ``departed`` flag (a host
+    stepping AFTER the reshard is alive whatever its index says —
+    never hidden from blame).  Returns the width record."""
+    width = fleet_width(states)
+    cur = (width or {}).get("current")
+    ts = (width or {}).get("ts") or 0.0
+    for st in states:
+        st.departed = (
+            cur is not None
+            and st.process_index is not None
+            and st.process_index >= cur
+            and (st.last_step_ts is None or st.last_step_ts <= ts))
+    return width
 
 
 # -- skew blame ---------------------------------------------------------------
@@ -244,8 +308,10 @@ def blame(hosts: List[HostState]) -> Optional[Dict[str, Any]]:
     straggler's excess as collective wait, so compute excess alone
     cannot localize the culprit.  Returns None with fewer than two
     hosts carrying steps, or when nothing clears the floor and the
-    fleet is in lock-step."""
-    active = [h for h in hosts if h.window]
+    fleet is in lock-step.  Departed hosts (``apply_topology`` — the
+    cluster legitimately shrank around them) are not part of the
+    cluster anymore and never enter the verdict."""
+    active = [h for h in hosts if h.window and not h.departed]
     if len(active) < 2:
         return None
     comp = {h: h.components() for h in active}
@@ -341,6 +407,16 @@ def fleet_view(runs: List[Tuple[str, List[Dict[str, Any]]]],
         st.fold(events)
         states.append(st)
     kept, superseded, notes = _dedupe_latest(states)
+    width = apply_topology(kept)
+    departed = [st for st in kept if st.departed]
+    if departed:
+        notes.append(
+            f"cluster resharded to width {width['current']}"
+            + (f" (declared {width['declared']})"
+               if width.get("declared") else "")
+            + f": host(s) "
+            + ", ".join(f"p{st.process_index}" for st in departed)
+            + " departed legitimately — excluded from lag and blame")
     # legacy cross-host step-completion skew over the kept logs
     step_ts: Dict[int, Dict[int, float]] = {}
     for st in kept:
@@ -360,7 +436,7 @@ def fleet_view(runs: List[Tuple[str, List[Dict[str, Any]]]],
             skew["max_s"], skew["at_step"] = spread, step
     if spreads:
         skew["mean_s"] = sum(spreads) / len(spreads)
-    last_steps = [st.last_step for st in kept]
+    last_steps = [st.last_step for st in kept if not st.departed]
     rows = [st.row(now) for st in kept]
     # legacy per-process rows (fleet_summarize's exact field set)
     processes = []
@@ -380,6 +456,7 @@ def fleet_view(runs: List[Tuple[str, List[Dict[str, Any]]]],
             "step_lag": (max(last_steps) - min(last_steps))
             if last_steps else 0,
             "skew": skew,
+            "width": width,
             "blame": blame(kept),
             "superseded": superseded,
             "notes": notes,
@@ -428,7 +505,16 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
             f"ckpt {_pct(r.get('checkpoint_share', 0.0))}  "
             f"{hbm}"
             f"nonfinite {p['nonfinite_steps']}"
+            f"{'  DEPARTED' if r.get('departed') else ''}"
             f"{'  ENDED' if r.get('ended') else ''}  ({p['path']})")
+    width = view.get("width")
+    if width and width.get("current"):
+        line = f"width: {width['current']}"
+        if width.get("declared"):
+            line += f"/{width['declared']} declared"
+            if width["current"] != width["declared"]:
+                line += "  (DEGRADED — cluster resharded)"
+        lines.append(line)
     lines.append(f"step lag (fastest - slowest last step): "
                  f"{view['step_lag']}")
     skew = view["skew"]
@@ -561,8 +647,10 @@ class FleetWatcher:
 
     def snapshot(self) -> Dict[str, Any]:
         kept = self._kept()
+        width = apply_topology(kept)
         now = time.time()
-        last_steps = [h.last_step for h in kept if h.window]
+        last_steps = [h.last_step for h in kept
+                      if h.window and not h.departed]
         return {"dir": self.directory,
                 "files": len(self._tails),
                 "hosts": {f"p{h.process_index}"
@@ -571,6 +659,7 @@ class FleetWatcher:
                           for i, h in enumerate(kept)},
                 "lag_steps": (max(last_steps) - min(last_steps))
                 if last_steps else 0,
+                "width": width,
                 "blame": blame(kept)}
 
     # -- publishing ----------------------------------------------------------
@@ -580,7 +669,8 @@ class FleetWatcher:
         if not telemetry.enabled():
             return
         kept = self._kept()
-        active = [h for h in kept if h.window]
+        apply_topology(kept)
+        active = [h for h in kept if h.window and not h.departed]
         last_steps = [h.last_step for h in active]
         lag = (max(last_steps) - min(last_steps)) if last_steps else 0
         verdict = blame(kept)

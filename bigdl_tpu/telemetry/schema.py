@@ -108,6 +108,13 @@ STREAM_NAMES = frozenset({
     # certified cluster-consistent by the commit barrier, and a
     # supervised full-cluster restart
     "cluster/peer_lost", "cluster/commit", "cluster/restart",
+    # elastic resharding (docs/fault_tolerance.md "Elastic recovery"):
+    # a topology change — a restore onto a different mesh than wrote
+    # the checkpoint (source=restore, old→new process/device counts)
+    # or a supervised capacity-aware width change (source=supervisor,
+    # from_n/to_n/declared_n).  The fleet view folds it so hosts of a
+    # legitimately-shrunk cluster are marked departed, not stalled.
+    "cluster/reshard",
     # fleet aggregation (telemetry/fleet.py): the coordinator's live
     # watcher publishes the completed-step gap and the blamed per-step
     # excess as gauges, and a rate-limited skew-blame instant whenever

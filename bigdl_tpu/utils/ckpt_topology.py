@@ -1,0 +1,384 @@
+"""Topology-portable checkpoint metadata (docs/fault_tolerance.md
+"Elastic recovery").
+
+PR 7 made the cluster survive failures, but a checkpoint still restored
+only onto the exact process count / mesh shape that wrote it, so
+preemption recovery could only wait for the identical slice to come
+back.  Real fleets shrink and grow (DeepSpark, arXiv 1602.08191, treats
+membership change as the normal case), and ZeRO-style sharded optimizer
+state (arXiv 2004.13336) makes same-shape-only restore actively
+dangerous: every moment tensor is a 1/N shard, and a silent
+fall-back-to-replicated restore multiplies per-device HBM by N.
+
+This module is the shared topology record both checkpoint backends
+write and verify:
+
+- :func:`topology_of` — the writing run's mesh (axis names + sizes),
+  process/device counts, parameter-sync mode, and one record per state
+  leaf (global shape, dtype, ``PartitionSpec``).  Stored in
+  ``bigdl_meta.json`` (sharded backend) / ``ckptmeta.N.json`` (BTPU)
+  and covered by its own digest (:func:`digest`) so a mangled topology
+  record fails integrity verification exactly like a torn payload.
+- :func:`reshardable_onto` — the pre-load POLICY check: a checkpoint
+  restores onto any mesh where every recorded-sharded leaf can keep a
+  sharded placement (the target mesh carries the writing axes and each
+  sharded dimension divides by the target axis size).  Meshes of size
+  <= 1 are exempt — a single device holds the whole state by
+  definition (the gather-restore path).  Violations raise
+  :class:`TopologyMismatchError` *before any state is touched* — the
+  alternative is a silently-replicated ZeRO restore whose memory
+  contract is N× the writing run's.
+- :func:`check_target` — the full pre-load validation run by
+  ``sharded_ckpt.restore_train_step``: leaf-set / global-shape / dtype
+  equality against the live target tree, then the reshardability check.
+- :func:`restorable_mesh_sizes` — the widths a checkpoint can restore
+  onto (divisors of the gcd of every sharded dimension), printed by the
+  ``cli train`` preemption resume hint and the supervisor recipe.
+
+The actual data movement needs no new machinery: the sharded backend's
+orbax restore is driven by the TARGET shardings (each process reads the
+slices it needs off shared storage — gather-then-re-place), and BTPU
+checkpoints are gathered whole-model files, portable by construction.
+What this module adds is the contract: record the writing topology,
+validate the restore topology loudly, and announce an accepted reshard
+(``cluster/reshard`` instant) so the fleet view knows the membership
+legitimately changed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import ckpt_digest
+
+__all__ = ["TopologyMismatchError", "topology_of", "digest",
+           "verify_digest", "reshardable_onto", "check_target",
+           "differs_from_live", "restorable_mesh_sizes", "describe",
+           "leaf_records", "declared_width", "reshard_fields"]
+
+FORMAT = 1
+
+
+class TopologyMismatchError(RuntimeError):
+    """A checkpoint's recorded topology cannot restore onto the live
+    mesh (shape/dtype/leaf-set mismatch, missing mesh axis, or a
+    ZeRO-sharded leaf that cannot re-shard at the requested width).
+    Raised BEFORE any state is touched — the sibling of
+    ``CorruptCheckpointError`` for topology rather than integrity."""
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _spec_of(arr) -> Optional[List[Any]]:
+    """JSON-able PartitionSpec of a jax array under a NamedSharding:
+    one entry per dim — None | axis name | [axis names].  None for
+    replicated/unsharded/host arrays."""
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    # trailing Nones carry no information; an all-None spec is replicated
+    while out and out[-1] is None:
+        out.pop()
+    return out or None
+
+
+def leaf_records(tree) -> Dict[str, Dict[str, Any]]:
+    """``path -> {shape, dtype[, spec]}`` over a state pytree, the same
+    scalar normalization the sharded writer applies (``_sanitize``:
+    python/np scalars become 0-d arrays)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out: Dict[str, Dict[str, Any]] = {}
+    for key_path, leaf in flat:
+        a = leaf if isinstance(leaf, jax.Array) else np.asarray(leaf)
+        rec: Dict[str, Any] = {"shape": [int(s) for s in a.shape],
+                               "dtype": np.dtype(a.dtype).name}
+        spec = _spec_of(leaf)
+        if spec:
+            rec["spec"] = spec
+        out[_path_str(key_path)] = rec
+    return out
+
+
+def _mesh_axes(mesh) -> Dict[str, int]:
+    if mesh is None:
+        return {}
+    return {str(name): int(mesh.shape[name]) for name in mesh.axis_names}
+
+
+def _live_process_count() -> int:
+    try:
+        from bigdl_tpu.utils.engine import Engine
+
+        return int(Engine.process_count())
+    except Exception:  # noqa: BLE001 - engine not initialized
+        return 1
+
+
+def topology_of(step) -> Dict[str, Any]:
+    """The writing run's topology record for a TrainStep-shaped object
+    (``params``/``opt_state``/``buffers`` + ``mesh``): what a restore
+    needs to decide — loudly, pre-load — whether a different mesh can
+    take this checkpoint."""
+    mesh = getattr(step, "mesh", None)
+    tree = {"params": step.params, "opt_state": step.opt_state,
+            "buffers": step.buffers}
+    return {"format": FORMAT,
+            "process_count": _live_process_count(),
+            "device_count": int(mesh.devices.size) if mesh is not None
+            else 1,
+            "mesh": _mesh_axes(mesh),
+            "parameter_sync": getattr(step, "parameter_sync", None),
+            "leaves": leaf_records(tree)}
+
+
+def digest(topo: Dict[str, Any]) -> str:
+    """Content digest of the canonical JSON of a topology record — the
+    meta marker carries it so a mangled topology fails integrity
+    verification like a torn payload (the PR-5 discipline applied to
+    the record that gates resharding decisions)."""
+    blob = json.dumps(topo, sort_keys=True, separators=(",", ":"))
+    return ckpt_digest.digest_bytes(blob.encode())
+
+
+def verify_digest(meta: Dict[str, Any]) -> List[str]:
+    """Problems with a meta marker's topology record (empty = fine or
+    absent — pre-topology checkpoints stay restorable)."""
+    topo = meta.get("topology")
+    want = meta.get("topology_digest")
+    if topo is None and want is None:
+        return []
+    if topo is None or want is None:
+        return ["topology record and its digest must travel together"]
+    got = digest(topo)
+    if got != want:
+        return [f"topology record digest mismatch (recorded {want}, "
+                f"computed {got})"]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# restore-side validation
+# ---------------------------------------------------------------------------
+
+def _axis_product(axes, sizes: Dict[str, int]) -> Optional[int]:
+    n = 1
+    for a in axes:
+        if a not in sizes:
+            return None
+        n *= int(sizes[a])
+    return n
+
+
+def reshardable_onto(topo: Dict[str, Any], mesh) -> Tuple[bool, List[str]]:
+    """Whether the recorded topology can restore onto ``mesh`` without
+    changing the sharded-memory contract.  Rule: every recorded-sharded
+    leaf must keep a sharded placement — the target mesh carries the
+    writing axes and each sharded dimension divides by the target axis
+    size.  Meshes of size <= 1 (or None) are exempt: one device holds
+    the whole state by definition."""
+    if mesh is None or int(mesh.devices.size) <= 1:
+        return True, []
+    sizes = _mesh_axes(mesh)
+    problems: List[str] = []
+    for path, rec in sorted((topo.get("leaves") or {}).items()):
+        spec = rec.get("spec")
+        if not spec:
+            continue
+        shape = rec.get("shape") or []
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, list) else [entry]
+            n = _axis_product(axes, sizes)
+            if n is None:
+                problems.append(
+                    f"{path}: dim {d} was sharded over axis "
+                    f"{'x'.join(axes)!r} which the restore mesh lacks "
+                    f"(axes {sorted(sizes)})")
+                continue
+            if n > 1 and (d >= len(shape) or shape[d] % n != 0):
+                dim = shape[d] if d < len(shape) else "?"
+                problems.append(
+                    f"{path}: shape {shape} dim {d} ({dim}) was sharded "
+                    f"over {'x'.join(axes)} "
+                    f"(size {topo.get('mesh', {}).get(axes[0], '?')}) and "
+                    f"cannot re-shard at size {n} — restoring here would "
+                    f"silently replicate a ZeRO shard (N× the writing "
+                    f"run's per-device memory); pick a width dividing "
+                    f"{dim}")
+    return not problems, problems
+
+
+def check_target(topo: Dict[str, Any], target_tree, mesh) -> None:
+    """Full pre-load validation of a restore: the recorded leaf set must
+    match the live target (global shapes and dtypes included — a
+    checkpoint cannot be resharded onto a *different model*), and the
+    target mesh must pass :func:`reshardable_onto`.  Raises
+    :class:`TopologyMismatchError` listing every problem; on success the
+    restore is a pure re-placement of bit-identical global arrays."""
+    recorded = topo.get("leaves") or {}
+    got = leaf_records(target_tree)
+    problems: List[str] = []
+    for path in sorted(set(recorded) - set(got)):
+        problems.append(f"checkpoint leaf {path} missing from the "
+                        f"restore target")
+    for path in sorted(set(got) - set(recorded)):
+        problems.append(f"restore target leaf {path} absent from the "
+                        f"checkpoint")
+    multi_device = mesh is not None and int(mesh.devices.size) > 1
+    for path in sorted(set(recorded) & set(got)):
+        r, g = recorded[path], got[path]
+        if list(r.get("shape") or []) != g["shape"]:
+            problems.append(f"{path}: checkpoint shape {r.get('shape')} "
+                            f"!= target shape {g['shape']}")
+        elif r.get("dtype") != g["dtype"]:
+            problems.append(f"{path}: checkpoint dtype {r.get('dtype')} "
+                            f"!= target dtype {g['dtype']}")
+        elif r.get("spec") and multi_device and not g.get("spec"):
+            # a leaf the writer SHARDED landing replicated in the
+            # target is the silent N×-memory restore this gate exists
+            # to prevent — typically a parameter_sync mismatch (ZeRO
+            # checkpoint, allreduce restore).  Single-device targets
+            # are exempt (the gather path holds everything anyway).
+            problems.append(
+                f"{path}: was sharded {r['spec']} at write but the "
+                f"restore target places it REPLICATED — restoring "
+                f"would multiply per-device memory by the writing "
+                f"shard count (parameter_sync mismatch? checkpoint "
+                f"says {topo.get('parameter_sync')!r}); restore with "
+                f"a sharded layout or onto a single device")
+    ok, reshard_problems = reshardable_onto(topo, mesh)
+    problems.extend(reshard_problems)
+    if problems:
+        raise TopologyMismatchError(
+            "checkpoint topology cannot restore onto this mesh: "
+            + "; ".join(problems))
+
+
+def differs_from_live(topo: Dict[str, Any], mesh) -> bool:
+    """Whether restoring this checkpoint here is a RESHARD (announced
+    as a ``cluster/reshard`` instant) rather than a same-topology
+    restore."""
+    live_devices = int(mesh.devices.size) if mesh is not None else 1
+    if int(topo.get("device_count") or 1) != live_devices:
+        return True
+    if int(topo.get("process_count") or 1) != _live_process_count():
+        return True
+    return _mesh_axes(mesh) != {k: int(v) for k, v
+                                in (topo.get("mesh") or {}).items()}
+
+
+def reshard_fields(topo: Dict[str, Any], mesh, source: str,
+                   **extra) -> Optional[Dict[str, Any]]:
+    """The ``cluster/reshard`` instant fields for restoring ``topo``
+    onto ``mesh`` — one construction shared by both checkpoint
+    backends so the emitted schema cannot drift.  None when the
+    topologies match (no reshard to announce); otherwise logs the
+    restore-in-progress line and returns old→new process/device
+    counts + meshes, ``declared_n`` when the supervisor exported it,
+    and any caller ``extra`` (step, path).  The CALLER logs (on its
+    own wired logger) and emits the instant — the sharded backend only
+    after the restore actually lands."""
+    if not differs_from_live(topo, mesh):
+        return None
+    live_procs = _live_process_count()
+    live_devs = int(mesh.devices.size) if mesh is not None else 1
+    fields: Dict[str, Any] = dict(
+        source=source,
+        from_processes=int(topo.get("process_count") or 1),
+        to_processes=live_procs,
+        from_devices=int(topo.get("device_count") or 1),
+        to_devices=live_devs,
+        from_mesh={k: int(v) for k, v in (topo.get("mesh") or {}).items()},
+        to_mesh=_mesh_axes(mesh), **extra)
+    declared = declared_width()
+    if declared:
+        fields["declared_n"] = declared
+    return fields
+
+
+def declared_width() -> Optional[int]:
+    """The supervisor-declared full width, exported into every
+    supervised worker as ``BIGDL_SUPERVISOR_DECLARED_N`` — restore-path
+    ``cluster/reshard`` instants carry it so the fleet view can report
+    current vs declared without depending on the old-width run logs
+    surviving rotation."""
+    v = os.environ.get("BIGDL_SUPERVISOR_DECLARED_N")
+    try:
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# operator-facing summaries (resume hint, supervise recipe)
+# ---------------------------------------------------------------------------
+
+def restorable_mesh_sizes(topo: Dict[str, Any]) -> Optional[List[int]]:
+    """Widths (data-axis mesh sizes) this checkpoint can restore onto
+    under the :func:`reshardable_onto` rule: divisors of the gcd of
+    every sharded dimension (1 always qualifies — the gather-restore
+    path).  ``None`` = no sharded leaves recorded, any width works."""
+    g = 0
+    for rec in (topo.get("leaves") or {}).values():
+        spec = rec.get("spec")
+        if not spec:
+            continue
+        shape = rec.get("shape") or []
+        for d, entry in enumerate(spec):
+            if entry is not None and d < len(shape):
+                g = math.gcd(g, int(shape[d]))
+    if g == 0:
+        return None
+    # O(sqrt(g)) divisor walk: g can be a multi-million-element shard
+    # dim and this runs on the restore/preemption hot path (describe)
+    out = set()
+    for i in range(1, math.isqrt(g) + 1):
+        if g % i == 0:
+            out.add(i)
+            out.add(g // i)
+    return sorted(out)
+
+
+def describe(topo: Dict[str, Any]) -> str:
+    """One-line human summary for logs and the resume hint."""
+    mesh = topo.get("mesh") or {}
+    mesh_s = ",".join(f"{k}={v}" for k, v in mesh.items()) or "single-device"
+    sizes = restorable_mesh_sizes(topo)
+    onto = ("any width (no sharded state)" if sizes is None
+            else f"mesh sizes {{{','.join(str(s) for s in sizes)}}}")
+    return (f"written by {topo.get('process_count', 1)} process(es) on "
+            f"{topo.get('device_count', 1)} device(s) ({mesh_s}, "
+            f"sync={topo.get('parameter_sync')}); restores onto {onto}")

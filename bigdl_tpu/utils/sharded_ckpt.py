@@ -43,12 +43,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from bigdl_tpu.utils import ckpt_digest
+from bigdl_tpu.utils import ckpt_digest, ckpt_topology
 from bigdl_tpu.utils import file as File
+from bigdl_tpu.utils.ckpt_topology import TopologyMismatchError
 
 __all__ = ["save_train_step", "restore_train_step", "latest_step_dir",
            "latest_verified_step_dir", "verify_step_dir", "quarantine",
-           "prune_old", "CorruptCheckpointError"]
+           "prune_old", "CorruptCheckpointError", "TopologyMismatchError",
+           "read_topology", "restorable_onto_fn"]
 
 _META = "bigdl_meta.json"
 
@@ -125,6 +127,11 @@ def save_train_step(step, path: str, extra: Optional[Dict] = None,
     # advertising a torn checkpoint
     if _is_coordinator():
         File.remove(_join(path, _META))
+    # topology is recorded at dispatch time (shapes/specs don't change
+    # while the async write overlaps training) and committed with the
+    # meta marker: a restore onto a DIFFERENT mesh validates against it
+    # pre-load (docs/fault_tolerance.md "Elastic recovery")
+    topo = ckpt_topology.topology_of(step)
     ckptr.save(_join(path, "state"), _sanitize(_tree(step)), force=True)
 
     def finish():
@@ -135,7 +142,9 @@ def save_train_step(step, path: str, extra: Optional[Dict] = None,
             # integrity (the digests match) — restore verifies before
             # any state is touched
             digests = ckpt_digest.digest_dir(path, exclude=(_META,))
-            meta = {"extra": extra or {}, "digests": digests}
+            meta = {"extra": extra or {}, "digests": digests,
+                    "topology": topo,
+                    "topology_digest": ckpt_topology.digest(topo)}
             File.save(json.dumps(meta).encode(), _join(path, _META),
                       overwrite=True)
         # fault injection (bigdl_tpu/faults.py): a torn_ckpt plan entry
@@ -176,18 +185,43 @@ def _read_meta(path: str) -> Optional[Dict]:
 
 def verify_step_dir(path: str) -> Tuple[bool, List[str]]:
     """Integrity check of one checkpoint directory: the meta marker must
-    parse and every recorded digest must match the payload on disk.
-    Metas without digests (pre-digest checkpoints) pass as complete but
-    unverifiable — rejecting them would strand every existing
-    checkpoint."""
+    parse, every recorded digest must match the payload on disk, and
+    the topology record (when present) must match ITS digest — a
+    mangled topology would corrupt reshard decisions exactly like a
+    torn payload corrupts state.  Metas without digests (pre-digest
+    checkpoints) pass as complete but unverifiable — rejecting them
+    would strand every existing checkpoint."""
     meta = _read_meta(_resolve(path))
     if meta is None:
         return False, ["meta marker missing or unparseable"]
+    problems = list(ckpt_topology.verify_digest(meta))
     digests = meta.get("digests")
-    if not digests:
-        return True, []
-    problems = ckpt_digest.verify_digests(_resolve(path), digests)
+    if digests:
+        problems.extend(ckpt_digest.verify_digests(_resolve(path),
+                                                   digests))
     return not problems, problems
+
+
+def read_topology(path: str) -> Optional[Dict]:
+    """The topology record a checkpoint directory carries, or None
+    (pre-topology checkpoint)."""
+    meta = _read_meta(_resolve(path))
+    return (meta or {}).get("topology")
+
+
+def restorable_onto_fn(mesh) -> Callable[[str], bool]:
+    """Predicate for the discovery walk and retention: whether a step
+    dir's recorded topology can restore onto ``mesh``
+    (``ckpt_topology.reshardable_onto``; pre-topology checkpoints pass
+    — they predate sharded-contract recording)."""
+    def restorable(path: str) -> bool:
+        topo = read_topology(path)
+        if not topo:
+            return True
+        ok, _problems = ckpt_topology.reshardable_onto(topo, mesh)
+        return ok
+
+    return restorable
 
 
 def quarantine(path: str, problems: Optional[List[str]] = None) -> str:
@@ -212,15 +246,26 @@ def quarantine(path: str, problems: Optional[List[str]] = None) -> str:
 
 
 def restore_train_step(step, path: str) -> Dict:
-    """Restore into ``step`` IN PLACE, preserving the live shardings
-    (each leaf restores against the step's current array as the abstract
-    target, so placement follows the current mesh).  Returns the saved
-    ``extra`` dict.
+    """Restore into ``step`` IN PLACE, placing every leaf under the
+    step's CURRENT mesh sharding — orbax's restore is driven by the
+    target, so a checkpoint written by a different mesh reshards on
+    load (each process reads the slices it needs off shared storage).
+    Returns the saved ``extra`` dict.
 
-    Content digests recorded at save time are verified FIRST — a torn
-    or bit-flipped checkpoint raises :class:`CorruptCheckpointError`
-    before any of the step's state is touched, so a failed restore can
-    never leave the step half-loaded."""
+    Two pre-load gates, both before any state is touched:
+
+    - content digests (PR 5): a torn/bit-flipped checkpoint raises
+      :class:`CorruptCheckpointError`;
+    - topology (docs/fault_tolerance.md "Elastic recovery"): the
+      recorded leaf set / global shapes / dtypes must match the live
+      target, and every recorded-sharded leaf must keep a sharded
+      placement on the live mesh — otherwise
+      :class:`TopologyMismatchError` (the checkpoint is NOT quarantined;
+      it is intact, merely not restorable at this width).
+
+    A restore whose topology legitimately differs (the cluster shrank
+    or grew) is announced with a ``cluster/reshard`` instant carrying
+    the old→new topology."""
     path = _resolve(path)
     ckptr = _checkpointer()
     ckptr.wait_until_finished()  # never race an in-flight save
@@ -229,12 +274,30 @@ def restore_train_step(step, path: str) -> Dict:
         raise CorruptCheckpointError(
             f"checkpoint {path} failed integrity verification: "
             f"{'; '.join(problems)}")
+    meta = _read_meta(path)
+    topo = (meta or {}).get("topology")
+    reshard = None
+    if topo:
+        ckpt_topology.check_target(topo, _tree(step), step.mesh)
+        reshard = ckpt_topology.reshard_fields(topo, step.mesh,
+                                               source="restore",
+                                               path=path)
+        if reshard is not None:
+            log.info(f"[Reshard] restoring a checkpoint "
+                     f"{ckpt_topology.describe(topo)} onto "
+                     f"{reshard['to_processes']} process(es) / "
+                     f"{reshard['to_devices']} device(s)")
     target = _sanitize(_tree(step))
     restored = ckptr.restore(_join(path, "state"), target)
     step.params = restored["params"]
     step.opt_state = restored["opt_state"]
     step.buffers = restored["buffers"]
-    meta = _read_meta(path)
+    if reshard is not None:
+        # announced only AFTER the restore landed: a failed restore
+        # must not tell the fleet the membership legitimately changed
+        from bigdl_tpu import telemetry
+
+        telemetry.instant("cluster/reshard", **reshard)
     return (meta or {}).get("extra", {})
 
 
@@ -268,7 +331,9 @@ def latest_step_dir(root: str, prefix: str = "sharded") -> Optional[str]:
 
 def latest_verified_step_dir(root: str, prefix: str = "sharded",
                              do_quarantine: bool = True,
-                             max_step: Optional[int] = None
+                             max_step: Optional[int] = None,
+                             restorable_fn: Optional[
+                                 Callable[[str], bool]] = None
                              ) -> Optional[str]:
     """Newest complete checkpoint that also passes digest verification.
     Candidates that fail are quarantined (``*.corrupt``) on the way down
@@ -278,12 +343,23 @@ def latest_verified_step_dir(root: str, prefix: str = "sharded",
     ``max_step`` is the cluster-consistent variant
     (``parallel/cluster.py``): steps ABOVE the cap are skipped without
     quarantine — they are intact, merely never certified by the
-    cluster commit barrier, so a cluster restore must not see them."""
+    cluster commit barrier, so a cluster restore must not see them.
+
+    ``restorable_fn`` is the elastic variant (``restorable_onto_fn``):
+    verified checkpoints whose recorded topology cannot restore onto
+    the CURRENT mesh are likewise skipped WITHOUT quarantine — in a
+    mixed-topology dir the walk falls back to the newest step the
+    current width can actually take."""
     for _n, p in sorted(_numbered(root, prefix), reverse=True):
         if max_step is not None and _n > max_step:
             continue
         ok, problems = verify_step_dir(p)
         if ok:
+            if restorable_fn is not None and not restorable_fn(p):
+                log.warning(f"[Checkpoint] {p} is verified but its "
+                            f"topology cannot restore onto the current "
+                            f"mesh; trying the step before it")
+                continue
             return p
         if do_quarantine:
             try:
@@ -295,7 +371,9 @@ def latest_verified_step_dir(root: str, prefix: str = "sharded",
 
 def prune_old(root: str, keep: int, prefix: str = "sharded",
               trusted: Optional[str] = None,
-              keep_step: Optional[int] = None) -> List[str]:
+              keep_step: Optional[int] = None,
+              restorable_fn: Optional[Callable[[str], bool]] = None
+              ) -> List[str]:
     """Delete all but the newest ``keep`` complete checkpoints under
     ``root``; returns the pruned paths.  Retention policy the reference
     lacks (its ``model.n`` files accumulate forever) but pod-scale
@@ -311,25 +389,64 @@ def prune_old(root: str, keep: int, prefix: str = "sharded",
     ``keep_step`` additionally pins one step number (the cluster
     manifest's — ``parallel/cluster.py``): cluster restores are CAPPED
     at that step, so deleting it would strand the cluster even though
-    newer (uncertified) checkpoints exist on disk."""
+    newer (uncertified) checkpoints exist on disk.
+
+    ``restorable_fn`` (``restorable_onto_fn``) extends the guard to
+    mixed-topology dirs: retention must also never delete the last
+    checkpoint RESTORABLE ONTO THE CURRENT WIDTH — when every survivor
+    in the keep window carries a topology the current mesh cannot take,
+    the newest verified+restorable victim is retained as the elastic
+    fallback anchor (the checkpoint a degraded-width restore would
+    land on)."""
     if keep < 1:
         raise ValueError("keep must be >= 1")
     done = sorted(_numbered(root, prefix))
     victims = [v for v in done[:-keep] if v[0] != keep_step]
     if victims:
-        # the newest survivor that verifies makes every victim safe to
-        # drop (trusted short-circuit, then newest-first early exit);
-        # otherwise retain the newest verifying victim as the fallback
-        # anchor
         trusted = _resolve(trusted) if trusted else None
-        if not any(p == trusted or verify_step_dir(p)[0]
-                   for _n, p in sorted(done[-keep:], reverse=True)):
+        survivors = [p for _n, p in sorted(done[-keep:], reverse=True)]
+        # per-call verdict memos: verify_step_dir re-hashes every
+        # payload file in a step dir, and the two retention passes plus
+        # restorable_fn would otherwise re-read the same multi-GB dirs
+        # on every checkpoint save tail
+        _verified: Dict[str, bool] = {}
+        _restorable: Dict[str, bool] = {}
+
+        def good(p: str, need_restorable: bool) -> bool:
+            # trusted = the checkpoint this very save just wrote and
+            # digested — by construction verified AND written at (hence
+            # restorable onto) the current width
+            if trusted is not None and p == trusted:
+                return True
+            if p not in _verified:
+                _verified[p] = verify_step_dir(p)[0]
+            if not _verified[p]:
+                return False
+            if not need_restorable or restorable_fn is None:
+                return True
+            if p not in _restorable:
+                _restorable[p] = bool(restorable_fn(p))
+            return _restorable[p]
+
+        # two retention anchors, each the newest qualifying victim when
+        # no survivor qualifies: (1) verified AND restorable onto the
+        # current width (mixed-topology dirs), (2) verified at all (the
+        # pre-existing torn-fallback guard).  An anchor retained by (1)
+        # also satisfies (2), so the second pass sees it as a keeper.
+        retained: List[str] = []
+        needs = ([True] if restorable_fn is not None else []) + [False]
+        for need in needs:
+            if any(good(p, need) for p in survivors + retained):
+                continue
             for item in sorted(victims, reverse=True):
-                if verify_step_dir(item[1])[0]:
+                if good(item[1], need):
                     victims = [v for v in victims if v != item]
+                    retained.append(item[1])
                     log.warning(
                         f"[Checkpoint] retaining {item[1]} beyond keep="
-                        f"{keep}: it is the last verified-good checkpoint")
+                        f"{keep}: it is the last "
+                        f"{'current-width-restorable' if need else 'verified-good'}"
+                        f" checkpoint")
                     break
     pruned = []
     for _, p in victims:

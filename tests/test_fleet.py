@@ -345,3 +345,91 @@ def test_cluster_watchdog_flight_dump_carries_fleet_snapshot(tmp_path):
         assert "p1" in doc["evidence"]["fleet"]["hosts"]
     finally:
         telemetry.end_run()
+
+
+# -- elastic resharding: departed hosts are not "stalled" ---------------------
+def _append_reshard(path, to_n, declared_n, ts):
+    """Hand-append a cluster/reshard instant (the workers of the new,
+    smaller incarnation emit it from the restore path)."""
+    ev = {"v": 1, "ts": ts, "pid": 1, "tid": 0, "kind": "event",
+          "name": "cluster/reshard", "source": "restore",
+          "from_processes": declared_n, "to_processes": to_n,
+          "declared_n": declared_n}
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(ev) + "\n")
+
+
+def test_departed_hosts_not_blamed_after_reshard(tmp_path):
+    """ISSUE 12 satellite: hosts absent because the cluster
+    LEGITIMATELY shrank (a cluster/reshard instant says so) must not be
+    blamed ``stalled`` forever — they fold into the table as departed,
+    drop out of lag/blame, and the view carries current/declared
+    width."""
+    now = time.time()
+    # p2/p3 stopped at step 4 (the old width-4 incarnation); p0/p1
+    # continued to step 12 after the reshard to width 2
+    for pidx in (2, 3):
+        _write_host(tmp_path / f"run-a-p{pidx}-1.jsonl", pidx, steps=4,
+                    dur=0.1, pid_override=10 + pidx)
+    for pidx in (0, 1):
+        _write_host(tmp_path / f"run-b-p{pidx}-1.jsonl", pidx, steps=12,
+                    dur=0.1, pid_override=10 + pidx)
+    loaded = [(str(p), schema.read_events(str(p))[0])
+              for p in sorted(tmp_path.glob("run-*.jsonl"))]
+    # control: WITHOUT the reshard instant the shrink looks like a
+    # stall and p2/p3 take the blame
+    view = fleet_view(loaded)
+    assert view["blame"] is not None
+    assert view["blame"]["cause"] == "stalled"
+    assert view["blame"]["laggard"] in (2, 3)
+    assert view["width"] is None
+
+    _append_reshard(tmp_path / "run-b-p0-1.jsonl", to_n=2, declared_n=4,
+                    ts=now + 3600)
+    loaded = [(str(p), schema.read_events(str(p))[0])
+              for p in sorted(tmp_path.glob("run-*.jsonl"))]
+    view = fleet_view(loaded)
+    assert view["width"] == {"current": 2, "declared": 4,
+                             "ts": now + 3600, "source": "restore"}
+    assert view["hosts"]["p2"]["departed"] and view["hosts"]["p3"]["departed"]
+    assert not view["hosts"]["p0"]["departed"]
+    # the survivors are in lock-step: no verdict, no residual lag
+    assert view["blame"] is None
+    assert view["step_lag"] == 0
+    assert any("departed legitimately" in n for n in view["notes"])
+    text = format_fleet_view(view)
+    assert "DEPARTED" in text
+    assert "width: 2/4 declared  (DEGRADED — cluster resharded)" in text
+    # a host OUTSIDE the width that keeps stepping is alive, not hidden:
+    # blame can still see it
+    late = tmp_path / "run-c-p2-1.jsonl"
+    _write_host(late, 2, steps=20, dur=0.1, pid_override=99)
+    lines = []
+    for line in late.read_text().splitlines():
+        ev = json.loads(line)
+        ev["ts"] = float(ev.get("ts", now)) + 7200  # after the reshard
+        lines.append(json.dumps(ev))
+    late.write_text("\n".join(lines) + "\n")
+    loaded = [(str(p), schema.read_events(str(p))[0])
+              for p in sorted(tmp_path.glob("run-*.jsonl"))]
+    view = fleet_view(loaded)
+    assert not view["hosts"]["p2"]["departed"]
+
+
+def test_watcher_snapshot_carries_width_and_departed(tmp_path):
+    now = time.time()
+    for pidx in (2, 3):
+        _write_host(tmp_path / f"run-a-p{pidx}-1.jsonl", pidx, steps=4,
+                    dur=0.1, pid_override=10 + pidx)
+    for pidx in (0, 1):
+        _write_host(tmp_path / f"run-b-p{pidx}-1.jsonl", pidx, steps=12,
+                    dur=0.1, pid_override=10 + pidx)
+    _append_reshard(tmp_path / "run-b-p0-1.jsonl", to_n=2, declared_n=4,
+                    ts=now + 3600)
+    watcher = FleetWatcher(str(tmp_path), interval=60)
+    watcher.poll_once()
+    snap = watcher.snapshot()
+    assert snap["width"]["current"] == 2 and snap["width"]["declared"] == 4
+    assert snap["hosts"]["p3"]["departed"]
+    assert snap["lag_steps"] == 0
+    assert snap["blame"] is None

@@ -278,3 +278,44 @@ def test_two_process_sharded_validation_matches_full(tmp_path):
     a, b = np.load(mp), np.load(sp)
     np.testing.assert_allclose(a["__score"], b["__score"], rtol=1e-6)
     _assert_same_params(mp, sp)
+
+
+@pytest.mark.deadline(600)
+def test_four_process_preempt_resume_on_two_matches_uninterrupted(tmp_path):
+    """The ISSUE 12 acceptance path — the PR-7 recovery contract
+    GENERALIZED across mesh shapes: train on 4 processes, SIGTERM
+    mid-epoch-2 (``preempt@6`` — the slice-wide preemption shape),
+    then resume the SAME checkpoint dir on only 2 processes.  The
+    checkpoint is topology-portable: the width-2 cluster restores the
+    width-4 state (announced as a ``cluster/reshard`` instant),
+    fast-forwards to the exact next global batch, and the final params
+    equal the uninterrupted 4-process run's."""
+    import glob
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    base = dict(BIGDL_TEST_ITERS=8, BIGDL_TEST_CKPT_EVERY=4)
+    un = _run_cluster(tmp_path, "el_un", nproc=4,
+                      BIGDL_TEST_CKPT=str(tmp_path / "ckpt_un"), **base)
+    pre = _run_cluster(tmp_path, "el_pre", nproc=4, expect_out=False,
+                       BIGDL_TEST_CKPT=str(ckpt),
+                       BIGDL_FAULTS="preempt@6", **base)
+    assert not os.path.exists(pre), "preempted run must not publish params"
+    assert any(f.startswith("model.6") for f in os.listdir(ckpt)), \
+        sorted(os.listdir(ckpt))
+    tele = tmp_path / "tele_el"
+    resumed = _run_cluster(tmp_path, "el_res", nproc=2,
+                           BIGDL_TEST_CKPT=str(ckpt),
+                           BIGDL_TELEMETRY=str(tele), **base)
+    _assert_same_params(resumed, un)
+    # both width-2 workers restored the width-4 checkpoint and said so
+    from bigdl_tpu.telemetry.schema import read_events
+
+    marks = []
+    for path in glob.glob(str(tele / "run-*.jsonl")):
+        events, _errs = read_events(path)
+        marks += [e for e in events if e.get("kind") == "event"
+                  and e.get("name") == "cluster/reshard"]
+    assert len(marks) == 2, marks
+    assert all(e["from_processes"] == 4 and e["to_processes"] == 2
+               for e in marks), marks

@@ -21,6 +21,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: tests, not silently drop the subsystem from the lexical scan
 PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           "bigdl_tpu/utils/sharded_ckpt.py",
+          # elastic resharding (ISSUE 12): the topology record both
+          # checkpoint backends write and the pre-load reshard
+          # validation — a silent drop reverts checkpoints to
+          # same-shape-only restore
+          "bigdl_tpu/utils/ckpt_topology.py",
           "bigdl_tpu/parallel/cluster.py",
           # the serving layer (ISSUE 8): the bucketed compile cache the
           # batch Predictor ALSO routes through — a silent drop reverts

@@ -31,6 +31,11 @@ PINNED_MODULES = [
     "bigdl_tpu/faults.py",
     "bigdl_tpu/utils/ckpt_digest.py",
     "bigdl_tpu/utils/sharded_ckpt.py",
+    # elastic resharding (ISSUE 12): losing this silently reverts
+    # checkpoints to same-shape-only restore — a shrunk slice can no
+    # longer resume, and ZeRO restores onto the wrong width would
+    # silently replicate every moment shard
+    "bigdl_tpu/utils/ckpt_topology.py",
     # cluster fault tolerance (ISSUE 7): losing this silently reverts
     # peer loss to an indefinite collective hang and restores to
     # per-host (possibly mixed-step) discovery

@@ -155,6 +155,14 @@ if mem.get("peak_bytes"):
 fl = st.get("fleet") or {}
 if fl.get("hosts"):
     line += f" fleet={len(fl['hosts'])}h/lag{fl.get('lag_steps', 0)}"
+    # elastic recovery (docs/fault_tolerance.md): current/declared
+    # width when the cluster runs DEGRADED after a capacity-aware
+    # reshard — the babysitter sees "2/4" instead of guessing why half
+    # the hosts went quiet
+    w = fl.get("width") or {}
+    if w.get("current") and w.get("declared") \
+            and w["current"] != w["declared"]:
+        line += f" width={w['current']}/{w['declared']}!DEGRADED"
     bl = fl.get("blame") or {}
     if bl.get("cause"):
         line += (f" blame=p{bl.get('laggard', '?')}:{bl['cause']}"
