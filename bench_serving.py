@@ -28,10 +28,24 @@ contract) compare: p50/p99 regress up, qps regresses down, and
 ``steady_compiles``/``retrace_diagnostics``/``rejected`` are
 zero-slack counters — ONE production recompile fails the gate.
 
+``--generate`` switches the harness to the LLM decode path
+(docs/serving.md "Autoregressive generation"): the same open-loop
+schedule drives ``POST /v1/generate`` with a mixed prompt-length cycle
+(``--gen-mix``), reads each token off the chunked stream as it lands,
+and banks the generation row — ``tokens_s`` (sustained emitted
+tokens/s), ``ttft_p50_ms``/``ttft_p99_ms`` (time to first token — the
+prefill + queue cost a user feels), and ``itl_p99_ms`` (p99 inter-token
+latency — the decode-step tail).  All four are diff-gated:
+``tokens_s`` regresses down, the latencies regress up, and the same
+zero-slack ``steady_compiles``/``retrace_diagnostics`` counters hold —
+a decode executable compiling mid-stream is a frozen token stream.
+
 Usage::
 
     python bench_serving.py --model lenet --qps 100 --duration 10
     python bench_serving.py --model lenet --diff-against BENCH_serving.json
+    python bench_serving.py --model transformer --generate --qps 5 \
+        --duration 10 --gen-mix 8,24,64 --max-new-tokens 16
 """
 
 from __future__ import annotations
@@ -46,6 +60,16 @@ import urllib.request
 import numpy as np
 
 __all__ = ["run_load", "main"]
+
+
+def _pct(sorted_vals, p):
+    """Nearest-rank percentile over a pre-sorted list; ``None`` when
+    empty (client-side stats distinguish "no samples" from 0 ms)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              int(round(p / 100.0 * (len(sorted_vals) - 1))))
+    return round(sorted_vals[idx], 3)
 
 
 def _synth_rows(spec, rng, rows: int, seq_len=None) -> np.ndarray:
@@ -121,18 +145,101 @@ def run_load(server, spec, qps: float, duration_s: float, mix,
         t.join(timeout=duration_s + 3 * timeout_s)
     wall = time.perf_counter() - start
     lat = sorted(lat_ms)
-
-    def pct(p):
-        return round(lat[min(len(lat) - 1,
-                             int(round(p / 100 * (len(lat) - 1))))], 3) \
-            if lat else None
-
     return {"offered_qps": round(qps, 2),
             "qps": round(len(lat) / wall, 2) if wall > 0 else None,
             "requests": len(codes), "ok": len(lat),
             "rejected": sum(1 for c in codes if c == 429),
             "failed": sum(1 for c in codes if c not in (200, 429)),
-            "p50_ms": pct(50), "p99_ms": pct(99), "wall_s": round(wall, 3)}
+            "p50_ms": _pct(lat, 50), "p99_ms": _pct(lat, 99),
+            "wall_s": round(wall, 3)}
+
+
+def run_generate_load(server, qps: float, duration_s: float, gen_mix,
+                      max_new_tokens: int, vocab: int, senders: int = 8,
+                      temperature: float = 0.0,
+                      timeout_s: float = 60.0):
+    """Drive ``POST /v1/generate`` open-loop; returns client-side
+    generation stats.  ``gen_mix`` cycles prompt lengths (mixed-length
+    prefill is the scheduling case worth measuring); every request
+    streams and the client clocks each token as its chunk lands —
+    TTFT and inter-token latency are measured where the user sits,
+    queue wait included."""
+    n = max(1, int(qps * duration_s))
+    rng = np.random.default_rng(0)
+    url = f"http://127.0.0.1:{server.port}/v1/generate"
+    plan = []
+    for i in range(n):
+        plen = gen_mix[i % len(gen_mix)]
+        body = json.dumps(
+            {"prompt": rng.integers(1, vocab, plen).tolist(),
+             "max_new_tokens": max_new_tokens,
+             "temperature": temperature, "seed": i}).encode("utf-8")
+        plan.append((i / qps, body))
+    ttft_ms, itl_ms, codes, tokens = [], [], [], [0]
+    lock = threading.Lock()
+    idx = [0]
+    start = time.perf_counter()
+
+    def sender():
+        while True:
+            with lock:
+                if idx[0] >= len(plan):
+                    return
+                at, body = plan[idx[0]]
+                idx[0] += 1
+            delay = start + at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            got, stamps = 0, []
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                    code = r.status
+                    for line in r:
+                        if not line.strip():
+                            continue
+                        ev = json.loads(line)
+                        if "token" in ev:
+                            stamps.append(time.perf_counter())
+                            got += 1
+                        elif "error" in ev:
+                            code = -2
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:  # noqa: BLE001 - connection-level failure
+                code = -1
+            with lock:
+                codes.append(code)
+                tokens[0] += got
+                if code == 200 and stamps:
+                    ttft_ms.append((stamps[0] - t0) * 1000.0)
+                    itl_ms.extend((b - a) * 1000.0 for a, b in
+                                  zip(stamps, stamps[1:]))
+
+    threads = [threading.Thread(target=sender, daemon=True)
+               for _ in range(senders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 3 * timeout_s)
+    wall = time.perf_counter() - start
+    ttft = sorted(ttft_ms)
+    itl = sorted(itl_ms)
+    return {"offered_qps": round(qps, 2),
+            "requests": len(codes),
+            "ok": sum(1 for c in codes if c == 200),
+            "rejected": sum(1 for c in codes if c == 429),
+            "failed": sum(1 for c in codes if c not in (200, 429)),
+            "gen_tokens": tokens[0],
+            "tokens_s": round(tokens[0] / wall, 2) if wall > 0 else None,
+            "ttft_p50_ms": _pct(ttft, 50), "ttft_p99_ms": _pct(ttft, 99),
+            "itl_p50_ms": _pct(itl, 50), "itl_p99_ms": _pct(itl, 99),
+            "max_new_tokens": max_new_tokens,
+            "wall_s": round(wall, 3)}
 
 
 def main(argv=None) -> int:
@@ -157,6 +264,25 @@ def main(argv=None) -> int:
     ap.add_argument("--int8", action="store_true",
                     help="serve quantized with calibrated static "
                          "activation scales")
+    ap.add_argument("--generate", action="store_true",
+                    help="bench the LLM decode path: POST /v1/generate "
+                         "streamed token mix (tokens/s, TTFT, "
+                         "inter-token p99)")
+    ap.add_argument("--gen-mix", default="8,24,64", metavar="L,L,...",
+                    help="--generate: prompt-length cycle (mixed "
+                         "prefill shapes)")
+    ap.add_argument("--max-new-tokens", type=int, default=16,
+                    help="--generate: tokens emitted per request")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="--generate: 0 = greedy (default), >0 samples")
+    ap.add_argument("--decode-buckets", default=None, metavar="B,B,...",
+                    help="--generate: decode batch buckets (default "
+                         "1,2,4,8)")
+    ap.add_argument("--cache-buckets", default=None, metavar="C,C,...",
+                    help="--generate: KV cache-length buckets")
+    ap.add_argument("--vocab", type=int, default=0,
+                    help="--generate: vocab size for synthetic prompts "
+                         "(default: the model's)")
     ap.add_argument("--diff-against", default=None,
                     metavar="BASELINE.json",
                     help="compare against a prior bench_serving JSON "
@@ -169,7 +295,14 @@ def main(argv=None) -> int:
     from bigdl_tpu.models import registry
     from bigdl_tpu.serving import serve_model
 
-    model = registry.build_model(args.model, args.num_classes)
+    if args.generate:
+        # the shared build rule (unrolled transformer etc.) lives
+        # beside the decode subsystem — same path as cli serve
+        from bigdl_tpu.serving.generate import generation_model
+
+        model = generation_model(args.model, args.num_classes)
+    else:
+        model = registry.build_model(args.model, args.num_classes)
     spec = registry.input_spec(args.model, 1)
     if args.int8:
         from bigdl_tpu.nn.quantized import calibrate, quantize
@@ -181,6 +314,11 @@ def main(argv=None) -> int:
     def buckets(text):
         return [int(b) for b in text.split(",")] if text else None
 
+    seq_buckets = buckets(args.seq_buckets)
+    if args.generate and not seq_buckets:
+        from bigdl_tpu.serving.generate import default_seq_buckets
+
+        seq_buckets = default_seq_buckets(spec)
     with telemetry.maybe_run(meta={"cmd": "bench_serving",
                                    "model": args.model}) as owned_log:
         server = serve_model(
@@ -188,7 +326,10 @@ def main(argv=None) -> int:
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             queue_limit=args.queue_limit,
             batch_buckets=buckets(args.buckets),
-            seq_buckets=buckets(args.seq_buckets))
+            seq_buckets=seq_buckets,
+            generate=args.generate,
+            decode_buckets=buckets(args.decode_buckets),
+            cache_buckets=buckets(args.cache_buckets))
         print(f"# serving {args.model} on :{server.port}, "
               f"{server.executor.compile_count} buckets warm "
               f"({server.executor.warmup_s:.1f}s)",
@@ -201,10 +342,19 @@ def main(argv=None) -> int:
             with telemetry.span("serve/load", qps=args.qps,
                                 duration=args.duration):
                 with trace_retraces() as mon:
-                    stats = run_load(server, spec, args.qps,
-                                     args.duration, mix,
-                                     seq_mix=seq_mix,
-                                     senders=args.senders)
+                    if args.generate:
+                        stats = run_generate_load(
+                            server, args.qps, args.duration,
+                            [int(p) for p in args.gen_mix.split(",")],
+                            args.max_new_tokens,
+                            vocab=args.vocab or args.num_classes or 256,
+                            senders=args.senders,
+                            temperature=args.temperature)
+                    else:
+                        stats = run_load(server, spec, args.qps,
+                                         args.duration, mix,
+                                         seq_mix=seq_mix,
+                                         senders=args.senders)
             steady = server.executor.compile_count - warm_compiles
             row = dict(stats)
             try:
@@ -232,10 +382,16 @@ def main(argv=None) -> int:
     if owned_log:
         print(f"# telemetry run log: {owned_log}", file=sys.stderr)
 
-    name = f"serve_{args.model}"
-    line = {"metric": f"serving_{args.model}_qps",
-            "value": row.get("qps"), "unit": "qps",
-            "vs_baseline": None, "configs": {name: row}}
+    if args.generate:
+        name = f"generate_{args.model}"
+        line = {"metric": f"serving_{args.model}_gen_tokens_s",
+                "value": row.get("tokens_s"), "unit": "tokens/s",
+                "vs_baseline": None, "configs": {name: row}}
+    else:
+        name = f"serve_{args.model}"
+        line = {"metric": f"serving_{args.model}_qps",
+                "value": row.get("qps"), "unit": "qps",
+                "vs_baseline": None, "configs": {name: row}}
     print(json.dumps(line))
     sys.stdout.flush()
 

@@ -357,6 +357,15 @@ def cmd_serve(args) -> None:
         from bigdl_tpu.utils import serializer
 
         model = serializer.load_module(args.model_snapshot)
+    elif args.generate:
+        # scan stacks cannot be cache-addressed; the shared build rule
+        # (unrolled transformer etc.) lives beside the decode subsystem
+        from bigdl_tpu.serving.generate import generation_model
+
+        try:
+            model = generation_model(args.model, args.num_classes)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
     else:
         model = _build_model(args.model, args.num_classes)
     spec = registry.input_spec(args.model, 1)
@@ -378,6 +387,11 @@ def cmd_serve(args) -> None:
     def _buckets(text):
         return [int(b) for b in text.split(",")] if text else None
 
+    seq_buckets = _buckets(args.seq_buckets)
+    if args.generate and not seq_buckets:
+        from bigdl_tpu.serving.generate import default_seq_buckets
+
+        seq_buckets = default_seq_buckets(spec)
     with telemetry.maybe_run(meta={"cmd": "serve", "model": args.model,
                                    "batch": args.batch_size}):
         server = serve_model(
@@ -385,15 +399,23 @@ def cmd_serve(args) -> None:
             max_batch=args.batch_size, max_wait_ms=args.max_wait_ms,
             queue_limit=args.queue_limit,
             batch_buckets=_buckets(args.buckets),
-            seq_buckets=_buckets(args.seq_buckets),
+            seq_buckets=seq_buckets,
             compute_dtype=jnp.bfloat16 if args.bf16 and not args.int8
             else None,
-            request_timeout_s=args.request_timeout)
+            request_timeout_s=args.request_timeout,
+            generate=args.generate,
+            decode_buckets=_buckets(args.decode_buckets),
+            cache_buckets=_buckets(args.cache_buckets),
+            max_new_tokens_limit=args.max_new_tokens_limit)
         # readiness line AFTER warmup: every bucket is compiled once
         # this prints — tests and load balancers key off it
+        gen = ""
+        if args.generate:
+            gen = (f", generate decode={list(server.executor.decode_buckets)}"
+                   f" cache={list(server.executor.cache_buckets)}")
         print(f"serving {args.model} on port {server.port} "
               f"(buckets {list(server.executor.policy.batch_buckets)}, "
-              f"warmup {server.executor.warmup_s:.1f}s)", flush=True)
+              f"warmup {server.executor.warmup_s:.1f}s{gen})", flush=True)
         server.install_signal_handlers()
         server.wait()
         server.stop(drain=True)
@@ -572,6 +594,20 @@ def main(argv=None) -> None:
                          "registry weights")
     se.add_argument("--seed", type=int, default=42,
                     help="weight-init seed for fresh registry weights")
+    se.add_argument("--generate", action="store_true",
+                    help="causal token models: enable POST /v1/generate"
+                         " — KV-cached decode, continuous batching, "
+                         "token streaming (docs/serving.md)")
+    se.add_argument("--decode-buckets", default=None, metavar="B,B,...",
+                    help="--generate: decode batch buckets; the largest"
+                         " is the max concurrent sequences (default "
+                         "1,2,4,8)")
+    se.add_argument("--cache-buckets", default=None, metavar="C,C,...",
+                    help="--generate: KV cache-length buckets (default:"
+                         " doubling from the smallest seq bucket to the"
+                         " model's max_len)")
+    se.add_argument("--max-new-tokens-limit", type=int, default=1024,
+                    help="--generate: per-request max_new_tokens cap")
     se.set_defaults(fn=cmd_serve)
 
     sv = sub.add_parser("supervise",

@@ -31,7 +31,12 @@ __all__ = ["build_transformer_lm", "PositionalEmbedding"]
 
 
 class PositionalEmbedding(Module):
-    """Learned absolute positions added to token embeddings."""
+    """Learned absolute positions added to token embeddings.
+
+    Under a DECODE generation trace (``serving/generate``) the input is
+    one token per row and its absolute position is that row's cache
+    length, not 0 — the ambient cache context supplies the per-row
+    positions the same way it supplies the per-layer caches."""
 
     def __init__(self, max_len: int, embed_dim: int):
         super().__init__()
@@ -39,6 +44,12 @@ class PositionalEmbedding(Module):
         self.weight = Parameter(jnp.zeros((max_len, embed_dim), jnp.float32))
 
     def update_output(self, input):
+        from bigdl_tpu.nn.layers.attention import generation_cache_context
+
+        ctx = generation_cache_context()
+        if ctx is not None and ctx.mode == "decode":
+            pos = ctx.positions()  # [B] absolute position per row
+            return input + self._params["weight"][pos, :][:, None, :]
         s = input.shape[1]
         return input + self._params["weight"][None, :s, :]
 

@@ -10,6 +10,7 @@ strategy (ring / Ulysses over a mesh ``seq`` axis).
 from __future__ import annotations
 
 import math
+import sys
 from typing import Optional
 
 import jax
@@ -19,6 +20,16 @@ from bigdl_tpu.nn.layers.linear import Linear
 from bigdl_tpu.nn.module import Module, Parameter
 
 __all__ = ["LayerNorm", "MultiHeadAttention", "TransformerBlock"]
+
+
+def generation_cache_context():
+    """The ambient KV-cache context bound by a generation trace
+    (``serving/generate/kv_cache.py``), or None.  Resolved through
+    ``sys.modules`` so the nn layer never imports the serving stack:
+    a process that never generated cannot have bound a context, and a
+    process that did has the module loaded already."""
+    mod = sys.modules.get("bigdl_tpu.serving.generate.kv_cache")
+    return mod.current() if mod is not None else None
 
 
 class LayerNorm(Module):
@@ -164,7 +175,17 @@ class MultiHeadAttention(Module):
             q = self._split(self.q_proj.forward(xq))
             k = self._split(self.k_proj.forward(xk))
             v = self._split(self.v_proj.forward(xv))
-        out = self._attend(q, k, v, mask)
+        ctx = generation_cache_context()
+        out = None
+        if ctx is not None and self.causal and xq is xk:
+            # generation trace: prefill RECORDS the fresh k/v (and falls
+            # through to the normal backend below — long prompts keep
+            # the flash path); decode scatters the single new k/v row
+            # into this layer's cache and returns q-against-cache
+            # attention (dense by the q_len=1 routing rule)
+            out = ctx.attend(q, k, v, causal=self.causal)
+        if out is None:
+            out = self._attend(q, k, v, mask)
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         if self.dropout_p > 0.0:

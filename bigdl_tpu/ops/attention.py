@@ -163,12 +163,20 @@ def select_attention_backend(sq: int, sk: int,
     ``flash_min_seq()`` up (judged on BOTH lengths so a short-query
     cross-attention over a long k/v still streams), dense below it or
     off-TPU.  Dense masks (beyond ``causal``) always route dense: the
-    flash kernel does not take a mask operand."""
+    flash kernel does not take a mask operand.  ``sq == 1`` — the KV-
+    cached DECODE shape — always routes dense regardless of kv length
+    (and regardless of ``BIGDL_KERNELS=pallas``): a flash q block is
+    128 MXU rows of which decode fills exactly one, so the kernel would
+    compute 127/128 padding per k block, while dense q_len=1 is a
+    single batched matvec — exactly the shape the MXU handles without
+    tiling ceremony."""
     from bigdl_tpu.ops.dispatch import kernel_mode
 
     mode = kernel_mode()
     if mode == "xla":
         return "dense", "forced:BIGDL_KERNELS=xla"
+    if sq == 1:
+        return "dense", "decode:q_len=1"
     if masked:
         return "dense", "masked"
     if mode == "pallas":

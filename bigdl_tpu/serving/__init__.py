@@ -18,7 +18,11 @@ Layering (each usable on its own):
   batcher with backpressure and graceful drain,
 - :mod:`bigdl_tpu.serving.server`   — the stdlib-HTTP frontend
   (``POST /v1/predict``, ``/status``, ``/healthz``) on the proven
-  ``telemetry/metrics_http.py`` pattern.
+  ``telemetry/metrics_http.py`` pattern,
+- :mod:`bigdl_tpu.serving.generate` — the LLM decode subsystem: KV
+  cache, prefill/decode executables, continuous generation batching,
+  and ``POST /v1/generate`` token streaming (docs/serving.md
+  "Autoregressive generation").
 
 Entry points: ``python -m bigdl_tpu.models.cli serve --model lenet``
 and ``python bench_serving.py`` (the diff-gateable load harness).
@@ -29,8 +33,12 @@ from __future__ import annotations
 from bigdl_tpu.serving.batcher import ContinuousBatcher, QueueFullError
 from bigdl_tpu.serving.buckets import BucketPolicy
 from bigdl_tpu.serving.executor import BucketedExecutor, executor_for
+from bigdl_tpu.serving.generate import (GenerateExecutor,
+                                        GenerationBatcher,
+                                        GenerationRequest)
 from bigdl_tpu.serving.server import ModelServer, get, serve_model
 
 __all__ = ["BucketPolicy", "BucketedExecutor", "executor_for",
            "ContinuousBatcher", "QueueFullError", "ModelServer",
-           "serve_model", "get"]
+           "serve_model", "get", "GenerateExecutor", "GenerationBatcher",
+           "GenerationRequest"]

@@ -1,0 +1,583 @@
+"""Continuous batching for autoregressive generation.
+
+The one-shot :class:`~bigdl_tpu.serving.batcher.ContinuousBatcher`
+coalesces whole requests into one dispatch each; generation instead
+runs ONE dispatch per emitted token, so the unit that coalesces is the
+*decode step*: every iteration the worker runs a single ``[B, 1]``
+decode over ALL active sequences, samples one token per row on the
+host, and streams it to that row's client.  Prefill (the prompt's one
+big forward) and decode are split the way *Parallax* splits sparse from
+dense work — different shapes, different executables, one scheduler:
+
+- arrivals wait in a bounded queue (429 past ``queue_limit``, the PR-8
+  backpressure discipline) until a decode slot frees up;
+- admissions are prefilled together (mixed prompt lengths pad onto the
+  PR-8 seq buckets) and their first token — the TTFT token — is sampled
+  straight off the prefill logits;
+- a finished request's cache row is reusable at the very next
+  iteration: membership changes rebuild the stacked KV cache by
+  gathering surviving rows (``StackedKVCache.stack``), and a sequence
+  crossing its cache-length bucket pads the whole stack up to the next
+  bucket — every (decode batch, cache length) the scheduler can ask for
+  is in the executor's closed, AOT-warmed key space.
+
+Sampling is host-side with the persistent per-request RNG discipline:
+each request owns a ``numpy`` Philox generator seeded on (seed,
+request), so a sampled generation is reproducible from its seed alone
+— independent of batch composition, admission order, or server uptime.
+
+Telemetry: one ``generate`` event per COMPLETED request (tokens, dur,
+ttft_ms, itl_p99_ms), the ``serve/generate`` token counter per decode
+iteration, and the ``serve/active_seqs`` / ``serve/cache_occupancy``
+gauges — the raw material for ``/status.serving.generate``,
+``bigdl_gen_*`` metrics, and the fleet view's decode-replica columns.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import telemetry as _telemetry
+from bigdl_tpu.serving.batcher import QueueFullError, _pct
+from bigdl_tpu.serving.generate.kv_cache import StackedKVCache
+
+__all__ = ["GenerationBatcher", "GenerationRequest", "sample_token"]
+
+
+def sample_token(logits: np.ndarray, temperature: float = 0.0,
+                 top_k: int = 0,
+                 rng: Optional[np.random.Generator] = None) -> int:
+    """One next-token draw from a ``[V]`` log-prob row.
+
+    ``temperature <= 0`` is greedy (argmax — no RNG consumed, so greedy
+    requests are deterministic with no seed at all).  Otherwise the
+    log-probs are divided by ``temperature``, optionally truncated to
+    the ``top_k`` most likely ids, renormalized, and sampled from
+    ``rng`` — the caller's PERSISTENT per-request generator."""
+    logits = np.asarray(logits, np.float64)
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    if rng is None:
+        raise ValueError("sampled decoding needs the request's rng")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    # shift BEFORE scaling: softmax is shift-invariant, and the shifted
+    # max is exactly 0, so a tiny temperature drives the others to -inf
+    # (-> greedy) instead of the unshifted inf - inf -> NaN
+    scaled = (logits - np.max(logits)) / float(temperature)
+    if top_k and top_k < scaled.shape[-1]:
+        cutoff = np.partition(scaled, -top_k)[-top_k]
+        scaled = np.where(scaled >= cutoff, scaled, -np.inf)
+    probs = np.exp(scaled)
+    probs /= probs.sum()
+    return int(rng.choice(probs.shape[-1], p=probs))
+
+
+class GenerationRequest:
+    """One streaming generation: prompt in, a queue of token events out.
+
+    The worker pushes ``("token", id, t_wall)`` tuples and finally one
+    ``("done", stats)`` / ``("error", message)`` sentinel; the HTTP
+    handler drains them via :meth:`events`.  ``cancel()`` (client gone)
+    tells the scheduler to free the row at the next iteration instead
+    of decoding for nobody.
+    """
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
+                 "seed", "eos_token", "rng", "stream", "done", "error",
+                 "tokens", "enqueued_at", "first_token_at",
+                 "last_token_at", "itl_ms", "cancelled", "finish_reason",
+                 "queue_ms")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0, eos_token: Optional[int] = None):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must carry at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.eos_token = eos_token
+        # the persistent per-request stream: every draw this request
+        # ever makes comes from here, keyed on (seed,) alone — the
+        # reproducibility contract is independent of batching
+        self.rng = np.random.Generator(np.random.Philox(self.seed))
+        self.stream: "queue.Queue" = queue.Queue()
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.tokens: List[int] = []
+        self.enqueued_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+        self.itl_ms: List[float] = []
+        self.cancelled = False
+        self.finish_reason: Optional[str] = None
+        self.queue_ms = 0.0
+
+    # -- worker side -------------------------------------------------------
+    def emit(self, token: int) -> None:
+        now = time.perf_counter()
+        if self.first_token_at is None:
+            self.first_token_at = now
+        else:
+            self.itl_ms.append((now - self.last_token_at) * 1000.0)
+        self.last_token_at = now
+        self.tokens.append(int(token))
+        self.stream.put(("token", int(token), now))
+
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return (self.first_token_at - self.enqueued_at) * 1000.0
+
+    def finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self.stream.put(("done", self.stats()))
+        self.done.set()
+
+    def fail(self, message: str) -> None:
+        self.error = message
+        self.finish_reason = "error"
+        self.stream.put(("error", message))
+        self.done.set()
+
+    def stats(self) -> Dict[str, Any]:
+        itl = sorted(self.itl_ms)
+        dur = (self.last_token_at - self.enqueued_at) \
+            if self.last_token_at else 0.0
+        return {"n_tokens": len(self.tokens),
+                "finish_reason": self.finish_reason,
+                "ttft_ms": round(self.ttft_ms() or 0.0, 3),
+                "itl_p99_ms": round(_pct(itl, 99.0), 3) if itl else 0.0,
+                "dur_s": round(dur, 4),
+                "tok_s": round(len(self.tokens) / dur, 2) if dur > 0
+                else None,
+                "queue_ms": round(self.queue_ms, 3)}
+
+    # -- client side -------------------------------------------------------
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def events(self, timeout: Optional[float] = None):
+        """Yield ``("token", id, t)`` tuples then the terminal
+        ``("done", stats)`` / ``("error", msg)``; raises TimeoutError
+        when the stream stalls past ``timeout`` between events."""
+        while True:
+            try:
+                ev = self.stream.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no token within {timeout}s") from None
+            yield ev
+            if ev[0] in ("done", "error"):
+                return
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class _Row:
+    """One active sequence: its request + scheduler-side position."""
+
+    __slots__ = ("req", "length", "last_token", "n_new")
+
+    def __init__(self, req: GenerationRequest, length: int,
+                 first_token: int):
+        self.req = req
+        self.length = length        # tokens IN the cache (prompt so far)
+        self.last_token = first_token
+        self.n_new = 1              # the prefill (TTFT) token counts
+
+
+class GenerationBatcher:
+    """Single worker thread interleaving prefill and coalesced decode.
+
+    ``executor`` is a warm :class:`GenerateExecutor`; ``max_active`` is
+    its largest decode bucket.  Admission control mirrors the predict
+    batcher: a bounded waiting queue, :class:`QueueFullError` past
+    capacity or once draining, and ``stop(drain=True)`` finishes every
+    in-flight generation before parking (the SIGTERM path).
+    """
+
+    def __init__(self, executor, max_wait_ms: float = 2.0,
+                 queue_limit: int = 64,
+                 eos_token: Optional[int] = None):
+        self.executor = executor
+        self.max_active = executor.max_active
+        self.max_wait_s = max(0.0, max_wait_ms) / 1000.0
+        self.queue_limit = queue_limit
+        self.eos_token = eos_token
+        self._q: "queue.Queue[GenerationRequest]" = queue.Queue(
+            maxsize=queue_limit)
+        self._active: List[_Row] = []
+        self._stack: Optional[StackedKVCache] = None
+        self._stats_lock = threading.Lock()
+        self.requests = 0
+        self.rejected = 0
+        self.completed = 0
+        self.errors = 0
+        self.gen_tokens = 0
+        self._ttft_ms: collections.deque = collections.deque(maxlen=2048)
+        self._itl_ms: collections.deque = collections.deque(maxlen=8192)
+        # (wall ts, tokens emitted) per decode iteration — tokens/s
+        self._token_times: collections.deque = collections.deque(
+            maxlen=8192)
+        self._draining = False
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="bigdl-generate-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: int = 0,
+               eos_token: Optional[int] = None) -> GenerationRequest:
+        """Enqueue one generation; raises :class:`QueueFullError` at
+        capacity or once draining."""
+        if self._draining or self._stopped.is_set():
+            raise QueueFullError("server is draining")
+        if top_k < 0:
+            # reject up front (the frontend's 400) — sample_token would
+            # only raise mid-stream, after the 200 already went out
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not np.isfinite(temperature) or temperature < 0.0:
+            # json.loads happily parses NaN/Infinity — reject here, not
+            # in the worker where one poisoned distribution would fail
+            # mid-stream
+            raise ValueError("temperature must be a finite float >= 0, "
+                             f"got {temperature}")
+        req = GenerationRequest(prompt, max_new_tokens=max_new_tokens,
+                                temperature=temperature, top_k=top_k,
+                                seed=seed,
+                                eos_token=eos_token if eos_token
+                                is not None else self.eos_token)
+        largest = self.executor.cache_buckets[-1]
+        if req.prompt.size >= largest:
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens leaves no room to "
+                f"generate in the largest cache bucket {largest}")
+        smax = self.executor.policy.seq_buckets[-1]
+        if req.prompt.size > smax:
+            # the prefill shape set is closed; padding truncates, so an
+            # over-long prompt would silently lose its tail — reject
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens exceeds the "
+                f"largest seq bucket {smax}")
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._stats_lock:
+                self.rejected += 1
+            _telemetry.counter("serve/rejected", 1)
+            raise QueueFullError(
+                f"generation queue at capacity ({self.queue_limit})"
+            ) from None
+        with self._stats_lock:
+            self.requests += 1
+        _telemetry.counter("serve/requests", 1)
+        return req
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    def active(self) -> int:
+        return len(self._active)
+
+    # -- the worker --------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            if self._stopped.is_set():
+                self._fail_all("server stopped")
+                return
+            try:
+                self._admit()
+                if not self._active:
+                    if self._draining and self._q.empty():
+                        self._stopped.set()
+                        return
+                    time.sleep(0.005)
+                    continue
+                self._step()
+            except BaseException as e:  # noqa: BLE001 - relayed per row
+                self._fail_active(f"{type(e).__name__}: {e}")
+
+    def _take_waiting(self, room: int) -> List[GenerationRequest]:
+        """Pop up to ``room`` live requests; waits out ``max_wait_ms``
+        only when NOTHING is active (an idle device coalesces arrivals
+        for a fuller prefill; a busy one admits whatever is there)."""
+        out: List[GenerationRequest] = []
+        deadline = None
+        while len(out) < room:
+            block = not self._active and not out and not self._draining
+            try:
+                req = self._q.get(timeout=0.02 if block else 0.0)
+            except queue.Empty:
+                # active rows must not stall behind the coalescing
+                # window — only an otherwise-idle worker waits it out
+                if not out or self._active or deadline is None \
+                        or time.perf_counter() >= deadline:
+                    break
+                time.sleep(0.001)
+                continue
+            if req.cancelled:
+                req.finish("cancelled")
+                continue
+            if deadline is None:
+                deadline = req.enqueued_at + self.max_wait_s
+            out.append(req)
+        return out
+
+    def _admit(self) -> None:
+        # one prefill dispatch per admission round: room is bounded by
+        # the free decode slots AND the prefill batch-bucket ceiling —
+        # a burst larger than max_batch admits over successive rounds
+        # (decode for the already-running rows interleaves)
+        room = min(self.max_active - len(self._active),
+                   self.executor.policy.max_batch)
+        if room <= 0:
+            return
+        newcomers = self._take_waiting(room)
+        if not newcomers:
+            return
+        t0 = time.perf_counter()
+        lengths = [r.prompt.size for r in newcomers]
+        smax = max(lengths)
+        tokens = np.zeros((len(newcomers), smax), np.int32)
+        for i, r in enumerate(newcomers):
+            tokens[i, :lengths[i]] = r.prompt
+            r.queue_ms = (t0 - r.enqueued_at) * 1000.0
+        try:
+            logits, caches = self.executor.prefill(tokens, lengths)
+        except BaseException as e:  # noqa: BLE001 - relayed per request
+            with self._stats_lock:
+                self.errors += len(newcomers)
+            for req in newcomers:
+                req.fail(f"{type(e).__name__}: {e}")
+            return
+        rows: List[_Row] = []
+        kept: List[int] = []
+        for i, req in enumerate(newcomers):
+            try:
+                tok = sample_token(logits[i], req.temperature,
+                                   req.top_k, req.rng)
+            except Exception as e:  # noqa: BLE001 - one bad request
+                # must not take down its co-admitted batch (or hang
+                # later newcomers in neither queue nor active)
+                with self._stats_lock:
+                    self.errors += 1
+                req.fail(f"{type(e).__name__}: {e}")
+                continue
+            req.emit(tok)  # the TTFT token, straight off the prefill
+            rows.append(_Row(req, lengths[i], tok))
+            kept.append(i)
+        with self._stats_lock:
+            self.gen_tokens += len(rows)
+            now = time.time()
+            self._token_times.append((now, len(rows)))
+            for row in rows:
+                ttft = row.req.ttft_ms()
+                if ttft is not None:
+                    self._ttft_ms.append(ttft)
+        _telemetry.counter("serve/generate", len(rows))
+        new_sources = [(caches, i, lengths[i]) for i in kept]
+        survivors = self._stack.row_sources(
+            list(range(len(self._active)))) if self._active else []
+        self._active.extend(rows)
+        self._rebuild(survivors + new_sources)
+        # a prompt already at its cache ceiling finishes on the TTFT
+        # token alone (nowhere to write the next k/v row)
+        self._retire(self._finished_rows())
+
+    def _rebuild(self, sources) -> None:
+        if not self._active:
+            self._stack = None
+            self._publish_gauges()  # idle must read 0, not last-busy
+            return
+        assert len(sources) == len(self._active)
+        max_len = max(r.length for r in self._active)
+        bucket = self.executor.cache_bucket(max_len + 1)
+        batch = self.executor.decode_batch_bucket(len(self._active))
+        self._stack = StackedKVCache.stack(sources, bucket, batch)
+        self._publish_gauges()
+
+    def _finished_rows(self) -> List[int]:
+        largest = self.executor.cache_buckets[-1]
+        out = []
+        for i, row in enumerate(self._active):
+            req = row.req
+            if req.cancelled:
+                if req.error is None:  # keep "error" for failed rows
+                    req.finish_reason = "cancelled"
+                out.append(i)
+            elif row.n_new >= req.max_new_tokens:
+                req.finish_reason = "length"
+                out.append(i)
+            elif req.eos_token is not None \
+                    and row.last_token == req.eos_token:
+                req.finish_reason = "stop"
+                out.append(i)
+            elif row.length >= largest:
+                # the next decode would write at index ``length``,
+                # which no longer exists — the last valid cell is
+                # ``largest - 1``, so a bucket of C buys exactly C
+                # positions of context
+                req.finish_reason = "cache_full"
+                out.append(i)
+        return out
+
+    def _retire(self, finished: Sequence[int]) -> None:
+        if not finished:
+            return
+        done = [self._active[i] for i in finished]
+        keep = [i for i in range(len(self._active))
+                if i not in set(finished)]
+        survivors = self._stack.row_sources(keep) if keep else []
+        self._active = [self._active[i] for i in keep]
+        self._rebuild(survivors)
+        tracer = _telemetry.get()
+        for row in done:
+            req = row.req
+            st = req.stats()
+            with self._stats_lock:
+                if req.error is None:
+                    self.completed += 1
+                self._itl_ms.extend(req.itl_ms)
+            if req.error is None:
+                # a failed row's terminal "error" event already went
+                # out via fail(); retiring it only frees the slot
+                req.finish(req.finish_reason or "stop")
+            if tracer is not None:
+                tracer.emit("generate", tokens=st["n_tokens"],
+                            dur=st["dur_s"], ttft_ms=st["ttft_ms"],
+                            itl_p99_ms=st["itl_p99_ms"],
+                            finish=req.finish_reason,
+                            queue_ms=st["queue_ms"])
+
+    def _step(self) -> None:
+        """One coalesced decode iteration over every active row."""
+        stack = self._stack
+        tokens = [row.last_token for row in self._active]
+        logits = self.executor.decode(stack, tokens)
+        emitted = 0
+        for i, row in enumerate(self._active):
+            # the executor scattered row i's token at position length;
+            # the scheduler owns advancing the row past it
+            row.length += 1
+            stack.lengths[i] += 1
+            try:
+                tok = sample_token(logits[i], row.req.temperature,
+                                   row.req.top_k, row.req.rng)
+            except Exception as e:  # noqa: BLE001 - one bad request
+                # must not take down the whole coalesced batch
+                with self._stats_lock:
+                    self.errors += 1
+                row.req.fail(f"{type(e).__name__}: {e}")
+                row.req.cancelled = True  # retired on the sweep below
+                continue
+            row.req.emit(tok)
+            row.last_token = tok
+            row.n_new += 1
+            emitted += 1
+        with self._stats_lock:
+            self.gen_tokens += emitted
+            self._token_times.append((time.time(), emitted))
+        _telemetry.counter("serve/generate", emitted)
+        finished = self._finished_rows()
+        if finished:
+            self._retire(finished)
+        elif max(r.length for r in self._active) + 1 > stack.bucket:
+            # a row crossed its cache bucket: pad the whole stack up
+            self._rebuild(stack.row_sources(
+                list(range(len(self._active)))))
+        else:
+            self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        _telemetry.gauge("serve/active_seqs", len(self._active))
+        _telemetry.gauge("serve/cache_occupancy",
+                         self._stack.occupancy() if self._stack else 0.0)
+
+    def _fail_active(self, message: str) -> None:
+        with self._stats_lock:
+            self.errors += len(self._active)
+        for row in self._active:
+            row.req.fail(message)
+        self._active = []
+        self._stack = None
+        self._publish_gauges()
+
+    def _fail_all(self, message: str) -> None:
+        self._fail_active(message)
+        while True:
+            try:
+                self._q.get_nowait().fail(message)
+            except queue.Empty:
+                return
+
+    # -- stats / lifecycle -------------------------------------------------
+    def stats(self, window_s: float = 60.0) -> Dict[str, Any]:
+        now = time.time()
+        # snapshot once: the worker swaps/nulls _stack without taking
+        # _stats_lock, so a second read could see a different object
+        stack = self._stack
+        with self._stats_lock:
+            recent = [(at, n) for (at, n) in self._token_times
+                      if now - at <= window_s]
+            ttft = sorted(self._ttft_ms)
+            itl = sorted(self._itl_ms)
+            out = {"requests": self.requests, "rejected": self.rejected,
+                   "completed": self.completed, "errors": self.errors,
+                   "gen_tokens": self.gen_tokens,
+                   "active_seqs": len(self._active),
+                   "waiting": self._q.qsize(),
+                   "queue_limit": self.queue_limit,
+                   "max_active": self.max_active,
+                   "cache_occupancy": stack.occupancy()
+                   if stack is not None else 0.0,
+                   "cache_bucket": stack.bucket
+                   if stack is not None else None,
+                   "draining": self._draining}
+        if recent:
+            span = min(window_s,
+                       max(0.25, now - min(at for at, _ in recent)))
+            out["tokens_s"] = round(sum(n for _, n in recent) / span, 2)
+        if ttft:
+            out["ttft_p50_ms"] = round(_pct(ttft, 50.0), 3)
+            out["ttft_p99_ms"] = round(_pct(ttft, 99.0), 3)
+        if itl:
+            out["itl_p50_ms"] = round(_pct(itl, 50.0), 3)
+            out["itl_p99_ms"] = round(_pct(itl, 99.0), 3)
+        return out
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Stop admissions; with ``drain`` finish every queued AND
+        in-flight generation first.  Returns True when the worker
+        parked in time."""
+        self._draining = True
+        if not drain:
+            self._stopped.set()
+        self._thread.join(timeout)
+        self._stopped.set()
+        parked = not self._thread.is_alive()
+        # TOCTOU sweep (the ContinuousBatcher.stop discipline): a
+        # submit that raced the drain check still owes its client an
+        # answer — the worker is dead here, so failing them is race-free
+        while True:
+            try:
+                self._q.get_nowait().fail("server stopped")
+            except queue.Empty:
+                break
+        return parked

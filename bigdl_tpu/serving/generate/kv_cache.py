@@ -1,0 +1,240 @@
+"""The KV cache: trace-time plumbing + the stacked per-request store.
+
+Two halves:
+
+- :class:`CacheContext` — a thread-local ambient context bound around a
+  traced forward (the same pattern as ``utils/rng.rng_context``).  When
+  bound, every ``MultiHeadAttention`` routes its freshly projected k/v
+  through :meth:`CacheContext.attend`: **prefill** records them (the
+  layer's normal attention still runs — long prompts ride the flash
+  kernel), **decode** scatters the single new k/v row into the cache at
+  each request's own position and computes q-against-cache dense
+  attention under a per-row length mask.  Layers are matched purely by
+  TRACE ORDER (a counter), so the context needs no registry of module
+  identities — the same model traces its attentions in the same order
+  every time, and the executable's cache operand order is defined by
+  that trace (``GenerateExecutor`` derives it via ``jax.eval_shape``).
+
+- :class:`StackedKVCache` — the host-side container the scheduler owns:
+  one ``[B, H, C, D]`` (k, v) pair per attention layer, row i belonging
+  to active request i, ``C`` drawn from a fixed closed set of
+  **cache-length buckets** (:func:`cache_buckets` — the PR-8 bucket
+  discipline extended to the time axis, so decode executables are
+  AOT-warmable).  Membership changes (a request finished — its row is
+  immediately reusable — or a new prefill joined) rebuild the stack by
+  gathering surviving rows; a request crossing its cache bucket pads the
+  whole stack up to the next bucket.  Between rebuilds the stack flows
+  through the decode executable untouched by the host.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["CacheContext", "StackedKVCache", "cache_buckets", "current",
+           "bind"]
+
+
+def cache_buckets(max_len: int, smallest: int = 64) -> Tuple[int, ...]:
+    """The closed set of cache-length buckets: ``smallest``, doubling,
+    capped at (and including) ``max_len``.  Every generated sequence
+    lives at the smallest bucket that holds it, so the decode executable
+    set is ``|decode batch buckets| x |cache buckets|`` — all AOT-warmed."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    out, b = [], min(smallest, max_len)
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class CacheContext:
+    """Ambient trace-time KV plumbing; see the module docstring.
+
+    ``mode``: ``"prefill"`` (record k/v, let the layer attend normally)
+    or ``"decode"`` (scatter into + attend against the bound caches).
+    ``lengths`` is the per-row token count already in the cache — in
+    decode mode it is also the position the new token is written to and
+    the index ``PositionalEmbedding`` looks up.  Rows padded onto the
+    batch bucket carry length 0 and produce garbage nobody reads.
+    """
+
+    def __init__(self, mode: str, lengths=None,
+                 caches: Optional[List[Tuple[Any, Any]]] = None):
+        if mode not in ("prefill", "decode"):
+            raise ValueError(f"mode must be prefill|decode, got {mode!r}")
+        if mode == "decode" and (lengths is None or caches is None):
+            raise ValueError("decode mode needs lengths and caches")
+        self.mode = mode
+        self.lengths = lengths
+        self.caches = caches or []
+        self.collected: List[Tuple[Any, Any]] = []
+        self._idx = 0
+
+    # -- the MultiHeadAttention hook ---------------------------------------
+    def attend(self, q, k, v, causal: bool = True,
+               scale: Optional[float] = None):
+        """Called by ``MultiHeadAttention`` with the projected
+        ``[B, H, S, D]`` q/k/v.  Returns the attention output in decode
+        mode, or None in prefill mode (record-only — the layer's normal
+        backend selection still runs the actual attention)."""
+        import jax.numpy as jnp
+
+        if self.mode == "prefill":
+            self.collected.append((k, v))
+            return None
+        if self._idx >= len(self.caches):
+            raise RuntimeError(
+                f"decode trace touched attention layer {self._idx} but "
+                f"only {len(self.caches)} caches were bound — the model "
+                f"changed shape since the cache specs were derived")
+        if q.shape[2] != 1:
+            raise ValueError(
+                f"decode expects q_len=1, got {q.shape[2]} — prefill "
+                f"longer inputs instead")
+        kc, vc = self.caches[self._idx]
+        self._idx += 1
+        rows = jnp.arange(kc.shape[0])
+        kc = kc.at[rows, :, self.lengths, :].set(
+            k[:, :, 0, :].astype(kc.dtype))
+        vc = vc.at[rows, :, self.lengths, :].set(
+            v[:, :, 0, :].astype(vc.dtype))
+        self.collected.append((kc, vc))
+        from bigdl_tpu.ops.attention import (dot_product_attention,
+                                             select_attention_backend)
+        from bigdl_tpu.ops.dispatch import note
+
+        # q_len=1: the routing table hard-routes decode to dense (a
+        # flash q block would be 127/128 padding) — recorded so
+        # attribution can see the decode path chose XLA on purpose
+        backend, reason = select_attention_backend(1, kc.shape[2],
+                                                   masked=True)
+        note("attention", "pallas" if backend == "flash" else "xla",
+             reason)
+        # row b attends cache positions 0..lengths[b] inclusive (the
+        # slot its own new token was just written to)
+        mask = (jnp.arange(kc.shape[2])[None, :]
+                <= self.lengths[:, None])[:, None, None, :]
+        return dot_product_attention(q, kc, vc, mask=mask, scale=scale)
+
+    def positions(self):
+        """Per-row absolute position of the current token (decode mode:
+        the write index) — what ``PositionalEmbedding`` adds."""
+        return self.lengths
+
+
+# -- ambient binding ---------------------------------------------------------
+class _Ambient(threading.local):
+    def __init__(self):
+        self.ctx: Optional[CacheContext] = None
+
+
+_ambient = _Ambient()
+
+
+def current() -> Optional[CacheContext]:
+    """The bound :class:`CacheContext` (None outside generation traces)."""
+    return _ambient.ctx
+
+
+@contextmanager
+def bind(mode: str, lengths=None, caches=None):
+    """Bind a fresh :class:`CacheContext` for the dynamic extent of one
+    traced forward; yields it so the caller can read ``collected``."""
+    prev = _ambient.ctx
+    ctx = CacheContext(mode, lengths=lengths, caches=caches)
+    _ambient.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _ambient.ctx = prev
+
+
+# -- the scheduler-owned stacked store ---------------------------------------
+class StackedKVCache:
+    """``[B, H, C, D]`` (k, v) per layer + host-side row lengths.
+
+    ``B`` is a decode batch bucket, ``C`` a cache-length bucket; row i
+    belongs to active request i (rows past ``n_rows`` are padding).  The
+    arrays live on device and flow through the decode executable; the
+    host only touches them on membership rebuilds.
+    """
+
+    def __init__(self, layers: List[Tuple[Any, Any]],
+                 lengths: Sequence[int], bucket: int, batch: int):
+        self.layers = layers          # [(k, v)] per attention layer
+        self.lengths = list(lengths)  # live rows only (len = n_rows)
+        self.bucket = int(bucket)     # C
+        self.batch = int(batch)       # B (>= n_rows)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.lengths)
+
+    def occupancy(self) -> float:
+        """Used cache cells / allocated cells — the ``/status`` and
+        ``serve/cache_occupancy`` gauge number."""
+        total = self.batch * self.bucket
+        return round(sum(self.lengths) / total, 4) if total else 0.0
+
+    def lengths_padded(self) -> np.ndarray:
+        out = np.zeros((self.batch,), np.int32)
+        out[:self.n_rows] = self.lengths
+        return out
+
+    @classmethod
+    def stack(cls, rows: List[Tuple[List[Tuple[Any, Any]], int, int]],
+              bucket: int, batch: int) -> "StackedKVCache":
+        """Build a stack from per-request rows.  Each row is
+        ``(layers, row_index, length)`` where ``layers`` is a stacked
+        source (``[B', H, C', D]`` per layer) and ``row_index`` picks the
+        request's row in it — so surviving rows of an old stack and the
+        rows of a fresh prefill batch gather with ONE slice each."""
+        import jax.numpy as jnp
+
+        if not rows:
+            raise ValueError("cannot stack zero rows")
+        if batch < len(rows):
+            raise ValueError(f"{len(rows)} rows > batch bucket {batch}")
+        n_layers = len(rows[0][0])
+        layers = []
+        for li in range(n_layers):
+            ks, vs = [], []
+            for src, ri, _length in rows:
+                k, v = src[li]
+                ks.append(cls._fit(k[ri], bucket))
+                vs.append(cls._fit(v[ri], bucket))
+            k = jnp.stack(ks)
+            v = jnp.stack(vs)
+            if batch > k.shape[0]:
+                pad = [(0, batch - k.shape[0])] + [(0, 0)] * (k.ndim - 1)
+                k = jnp.pad(k, pad)
+                v = jnp.pad(v, pad)
+            layers.append((k, v))
+        return cls(layers, [length for _, _, length in rows],
+                   bucket, batch)
+
+    @staticmethod
+    def _fit(arr, bucket: int):
+        """Pad or slice one ``[H, C', D]`` row onto cache length
+        ``bucket`` (slicing only ever drops cells past the row's length
+        — the scheduler never shrinks below a live sequence)."""
+        import jax.numpy as jnp
+
+        c = arr.shape[1]
+        if c == bucket:
+            return arr
+        if c > bucket:
+            return arr[:, :bucket, :]
+        return jnp.pad(arr, [(0, 0), (0, bucket - c), (0, 0)])
+
+    def row_sources(self, keep: Sequence[int]):
+        """Rebuild inputs for the surviving ``keep`` row indices —
+        feed straight back into :meth:`stack`."""
+        return [(self.layers, i, self.lengths[i]) for i in keep]

@@ -12,6 +12,14 @@ Endpoints:
   the queue is full (backpressure), ``503`` while draining, ``400`` on
   shape/JSON errors, ``504`` when a dispatch exceeds the request
   timeout;
+- ``POST /v1/generate`` (``generate=True`` servers) — body
+  ``{"prompt": [token ids], "max_new_tokens": n, "temperature": t,
+  "top_k": k, "seed": s, "stream": true}``.  Streaming (the default)
+  answers with chunked transfer encoding, one JSON line per token
+  (``{"token": id, "i": n}``) as each is sampled, closed by a
+  ``{"done": true, ...stats}`` line — time-to-first-byte IS
+  time-to-first-token.  ``stream: false`` returns one JSON object with
+  the full token list.  Same 429/503/400 discipline as predict;
 - ``GET /status``  — serving stats (qps, p50/p99 latency, queue depth,
   batch fill, padding waste, warm buckets, compile counts) merged with
   the same profiler/flight/cluster observer block ``/status`` carries
@@ -61,6 +69,14 @@ class ModelServer:
     ``models/registry.input_spec`` returns); its trailing dims are the
     per-sample feature shape and its dtype gates request parsing.
     ``seq_buckets`` (token models) buckets the time axis too.
+
+    ``generate=True`` (causal token models) adds the autoregressive
+    path: the executor becomes a :class:`GenerateExecutor` (prefill +
+    decode executables share the predict compile cache and ONE device
+    copy of the weights), a :class:`GenerationBatcher` coalesces decode
+    steps across requests, and ``POST /v1/generate`` streams tokens.
+    ``decode_buckets`` / ``cache_buckets`` bound its executable key
+    space; ``seq_buckets`` is required (prompts pad onto it).
     """
 
     def __init__(self, model, input_spec, name: str = "model",
@@ -69,19 +85,44 @@ class ModelServer:
                  queue_limit: int = 256,
                  batch_buckets=None, seq_buckets=None,
                  mesh=None, compute_dtype=None,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 generate: bool = False, decode_buckets=None,
+                 cache_buckets=None, eos_token: Optional[int] = None,
+                 max_new_tokens_limit: int = 1024):
         self.model = model.evaluate()
         self.name = name
         self.sample_shape: Tuple[int, ...] = tuple(input_spec.shape[1:])
         self.dtype = np.dtype(input_spec.dtype)
         self.request_timeout_s = request_timeout_s
+        self.max_new_tokens_limit = max_new_tokens_limit
         seq_axis = 1 if seq_buckets else None
         policy = BucketPolicy(max_batch=max_batch,
                               batch_buckets=batch_buckets,
                               seq_buckets=seq_buckets)
-        self.executor = executor_for(model, mesh=mesh, policy=policy,
-                                     compute_dtype=compute_dtype,
-                                     seq_axis=seq_axis)
+        self.gen_batcher = None
+        if generate:
+            from bigdl_tpu.serving.generate import (GenerateExecutor,
+                                                    GenerationBatcher)
+
+            if not seq_buckets:
+                raise ValueError(
+                    "generate=True needs seq_buckets (the prompt "
+                    "padding shapes)")
+            # a dedicated executor (not the shared executor_for cache):
+            # its key space carries prefill/decode executables the
+            # plain registry entry must never pay warmup for
+            self.executor = GenerateExecutor(
+                model, mesh=mesh, policy=policy,
+                compute_dtype=compute_dtype,
+                decode_buckets=decode_buckets,
+                cache_buckets=cache_buckets)
+            self.gen_batcher = GenerationBatcher(
+                self.executor, max_wait_ms=max_wait_ms,
+                queue_limit=queue_limit, eos_token=eos_token)
+        else:
+            self.executor = executor_for(model, mesh=mesh, policy=policy,
+                                         compute_dtype=compute_dtype,
+                                         seq_axis=seq_axis)
         self.batcher = ContinuousBatcher(
             self.executor.run, max_batch=max_batch,
             max_wait_ms=max_wait_ms, queue_limit=queue_limit,
@@ -91,6 +132,11 @@ class ModelServer:
         self._started_at = time.time()
         self._term = threading.Event()
         self._stopped = False
+        # open /v1/generate streams: the drain path waits for handlers
+        # to flush their final chunks before tearing the HTTP server
+        # down (the generations themselves finish via gen_batcher.stop)
+        self._streams_lock = threading.Lock()
+        self._open_streams = 0
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.model_server = self  # type: ignore[attr-defined]
@@ -170,6 +216,33 @@ class ModelServer:
                 f"model's {self.sample_shape}")
         return arr, single
 
+    def parse_generate(self, payload: Dict[str, Any]
+                       ) -> Tuple[Dict[str, Any], bool]:
+        """Validated kwargs for ``gen_batcher.submit`` + ``stream``;
+        raises ValueError (the frontend's 400) on anything malformed."""
+        if self.gen_batcher is None:
+            raise ValueError(
+                "this server was not started with generate=True")
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            raise ValueError('body must be {"prompt": [token ids], ...}')
+        prompt = np.asarray(payload["prompt"])
+        if prompt.ndim != 1 or prompt.size < 1 \
+                or not np.issubdtype(prompt.dtype, np.integer):
+            raise ValueError("prompt must be a flat non-empty list of "
+                             "integer token ids")
+        n = int(payload.get("max_new_tokens", 32))
+        if not 1 <= n <= self.max_new_tokens_limit:
+            raise ValueError(f"max_new_tokens must be in "
+                             f"[1, {self.max_new_tokens_limit}]")
+        out = {"prompt": prompt.astype(np.int32),
+               "max_new_tokens": n,
+               "temperature": float(payload.get("temperature", 0.0)),
+               "top_k": int(payload.get("top_k", 0)),
+               "seed": int(payload.get("seed", 0))}
+        if payload.get("eos_token") is not None:
+            out["eos_token"] = int(payload["eos_token"])
+        return out, bool(payload.get("stream", True))
+
     def predict(self, arr: np.ndarray) -> Tuple[Any, float]:
         """Submit rows and wait for the carrying batch; returns
         (outputs, queue_ms).  Raises QueueFullError / TimeoutError."""
@@ -199,6 +272,11 @@ class ModelServer:
                           for key in self.executor.warm_buckets()],
             compiles=self.executor.compile_count,
             warmup_s=round(self.executor.warmup_s, 3))
+        if self.gen_batcher is not None:
+            gen = self.gen_batcher.stats()
+            gen["decode_buckets"] = list(self.executor.decode_buckets)
+            gen["cache_buckets"] = list(self.executor.cache_buckets)
+            st["generate"] = gen
         try:
             # resident-executable HBM (weights + code + largest bucket
             # scratch): the number ROADMAP item 2's KV-cache budget
@@ -223,6 +301,21 @@ class ModelServer:
                 continue
             name = f"bigdl_serve_{key}" + (
                 "_total" if mtype == "counter" else "")
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.append(f'{name}{{model="{self.name}"}} {float(v):g}')
+        gen = st.get("generate") or {}
+        for key, mtype in (("gen_tokens", "counter"),
+                           ("tokens_s", "gauge"),
+                           ("ttft_p50_ms", "gauge"),
+                           ("ttft_p99_ms", "gauge"),
+                           ("itl_p99_ms", "gauge"),
+                           ("active_seqs", "gauge"),
+                           ("cache_occupancy", "gauge")):
+            v = gen.get(key)
+            if v is None:
+                continue
+            name = "bigdl_gen_tokens_total" if key == "gen_tokens" \
+                else f"bigdl_gen_{key}"
             lines.append(f"# TYPE {name} {mtype}")
             lines.append(f'{name}{{model="{self.name}"}} {float(v):g}')
         lines.append("# EOF")
@@ -256,6 +349,19 @@ class ModelServer:
         self._stopped = True
         self._term.set()
         drained = self.batcher.stop(drain=drain, timeout=timeout)
+        if self.gen_batcher is not None:
+            # in-flight generations finish their remaining tokens
+            # before the process exits — a rolling restart never
+            # truncates a stream mid-generation
+            drained = self.gen_batcher.stop(drain=drain,
+                                            timeout=timeout) and drained
+            if drain:
+                deadline = time.time() + 5.0
+                while time.time() < deadline:
+                    with self._streams_lock:
+                        if self._open_streams == 0:
+                            break
+                    time.sleep(0.02)
         _telemetry.instant("serve/drain", clean=bool(drained),
                            requests=self.batcher.requests,
                            rejected=self.batcher.rejected)
@@ -268,12 +374,20 @@ class ModelServer:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # chunked transfer encoding (the /v1/generate token stream) is
+    # undefined for HTTP/1.0 — proxies and strict clients would pass
+    # the raw chunk framing through to the user
+    protocol_version = "HTTP/1.1"
+
     def _server(self) -> ModelServer:
         return self.server.model_server  # type: ignore[attr-defined]
 
     def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         try:
             path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/v1/generate":
+                self._generate()
+                return
             if path != "/v1/predict":
                 self.send_error(404)
                 return
@@ -311,6 +425,78 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(500, {"error": f"{type(e).__name__}: {e}"})
             except Exception:  # noqa: BLE001 - client already gone
                 pass
+
+    def _generate(self) -> None:
+        """``POST /v1/generate``: submit, then either stream one JSON
+        line per token over chunked transfer encoding (time-to-first-
+        byte IS time-to-first-token) or block for the whole answer."""
+        srv = self._server()
+        if srv.gen_batcher is None:
+            self._json(404, {"error": "server not started with "
+                                      "--generate"})
+            return
+        if srv.draining():
+            self._json(503, {"error": "draining"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            kwargs, stream = srv.parse_generate(payload)
+        except (ValueError, TypeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        try:
+            req = srv.gen_batcher.submit(**kwargs)
+        except QueueFullError as e:
+            self._json(429, {"error": str(e)})
+            return
+        except ValueError as e:  # prompt vs cache-bucket bounds
+            self._json(400, {"error": str(e)})
+            return
+        if not stream:
+            if not req.wait(srv.request_timeout_s):
+                req.cancel()
+                self._json(504, {"error": "no completion within "
+                                          f"{srv.request_timeout_s}s"})
+                return
+            if req.error is not None:
+                self._json(500, {"error": req.error})
+                return
+            self._json(200, {"tokens": req.tokens, **req.stats()})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        with srv._streams_lock:
+            srv._open_streams += 1
+        try:
+            i = 0
+            for ev in req.events(timeout=srv.request_timeout_s):
+                if ev[0] == "token":
+                    self._chunk({"token": ev[1], "i": i})
+                    i += 1
+                elif ev[0] == "done":
+                    self._chunk({"done": True, "tokens": req.tokens,
+                                 **ev[1]})
+                else:  # error sentinel
+                    self._chunk({"error": ev[1]})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            # client gone or stalled: free the decode slot instead of
+            # generating for nobody; the chunked body was never
+            # terminated, so the connection cannot be reused
+            req.cancel()
+            self.close_connection = True
+        finally:
+            with srv._streams_lock:
+                srv._open_streams -= 1
+
+    def _chunk(self, obj: Dict[str, Any]) -> None:
+        data = (json.dumps(obj, default=str) + "\n").encode("utf-8")
+        self.wfile.write(f"{len(data):x}\r\n".encode("ascii")
+                         + data + b"\r\n")
+        self.wfile.flush()
 
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         try:

@@ -96,6 +96,13 @@ _RULES: List[Tuple[str, str, str]] = [
     (".rejected", "lower", "count"),
     (".steady_compiles", "lower", "count"),
     (".retrace_diagnostics", "lower", "count"),
+    # generation serving (bench_serving.py --generate): sustained token
+    # rate regresses DOWN; time-to-first-token and the inter-token tail
+    # regress UP — the decode-path p99 gate for the next TPU round
+    (".tokens_s", "higher", "pct"),
+    (".ttft_p50_ms", "lower", "pct"),
+    (".ttft_p99_ms", "lower", "pct"),
+    (".itl_p99_ms", "lower", "pct"),
 ]
 
 
@@ -202,9 +209,13 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
         if compile_s is not None:
             out[f"{name}.compile_s"] = float(compile_s)
         # serving rows (bench_serving.py): latency/rate + the zero-
-        # slack steady-state counters
+        # slack steady-state counters; generation rows (--generate)
+        # add sustained tokens/s, TTFT percentiles, and the
+        # inter-token tail
         for key in ("p50_ms", "p99_ms", "qps", "rejected",
-                    "steady_compiles", "retrace_diagnostics"):
+                    "steady_compiles", "retrace_diagnostics",
+                    "tokens_s", "ttft_p50_ms", "ttft_p99_ms",
+                    "itl_p99_ms"):
             if row.get(key) is not None:
                 out[f"{name}.{key}"] = float(row[key])
         # comms snapshot on bench rows (bench.py reads it off the scan

@@ -106,6 +106,14 @@ class HostState:
         self.hbm_live_bytes = 0
         self.hbm_limit_bytes = 0
         self._memory_pressured = False
+        # generation events (serving/generate): decode-replica columns —
+        # token totals, the latest TTFT / inter-token tail, and a
+        # (ts, tokens) window for a per-host tokens/s rate
+        self.gen_tokens = 0
+        self.gen_requests = 0
+        self.gen_ttft_ms = 0.0
+        self.gen_itl_p99_ms = 0.0
+        self._gen_window: deque = deque(maxlen=WINDOW_STEPS)
         # (step, ts, dur, components) rows, newest last
         self.window: deque = deque(maxlen=WINDOW_STEPS)
         self._pending: Dict[str, float] = {}
@@ -153,6 +161,15 @@ class HostState:
             elif kind == "health":
                 if ev.get("nonfinite_grads") or ev.get("nonfinite_params"):
                     self.nonfinite_steps += 1
+            elif kind == "generate":
+                toks = int(ev.get("tokens", 0) or 0)
+                self.gen_tokens += toks
+                self.gen_requests += 1
+                self.gen_ttft_ms = float(ev.get("ttft_ms", 0.0) or 0.0)
+                self.gen_itl_p99_ms = float(ev.get("itl_p99_ms", 0.0)
+                                            or 0.0)
+                if isinstance(ts, (int, float)):
+                    self._gen_window.append((ts, toks))
             elif kind == "comms":
                 self.comms_bytes = int(ev.get("bytes", 0) or 0)
                 s = ev.get("measured_s")
@@ -225,6 +242,18 @@ class HostState:
         out["compute"] = max(dur_total / n - sum(out.values()), 0.0)
         return out
 
+    def gen_tokens_s(self, now: Optional[float] = None,
+                     window_s: float = 60.0) -> float:
+        """Per-host generated tokens/s over the recent window (0.0 for
+        hosts that never generated — training hosts stay clean)."""
+        now = time.time() if now is None else now
+        recent = [(at, n) for (at, n) in self._gen_window
+                  if now - at <= window_s]
+        if not recent:
+            return 0.0
+        span = min(window_s, max(0.25, now - min(at for at, _ in recent)))
+        return round(sum(n for _, n in recent) / span, 2)
+
     def row(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = time.time() if now is None else now
         comp = self.components()
@@ -248,6 +277,11 @@ class HostState:
                 "hbm_limit_bytes": self.hbm_limit_bytes,
                 "memory_pressure": self.memory_pressure(),
                 "nonfinite_steps": self.nonfinite_steps,
+                "gen_tokens": self.gen_tokens,
+                "gen_requests": self.gen_requests,
+                "gen_tokens_s": self.gen_tokens_s(now),
+                "gen_ttft_ms": self.gen_ttft_ms,
+                "gen_itl_p99_ms": self.gen_itl_p99_ms,
                 "checkpoint_step": self.ckpt_step,
                 "checkpoint_age_s": (round(now - self.ckpt_ts, 3)
                                      if self.ckpt_ts else None),
@@ -496,6 +530,11 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
             hbm += "  "
             if r.get("memory_pressure"):
                 hbm = hbm.rstrip() + "!  "
+        if r.get("gen_tokens"):
+            # decode replica: the host's useful work is tokens, not
+            # steps — show the rate and tail next to the step columns
+            hbm += (f"gen {r.get('gen_tokens_s', 0.0)}tok/s "
+                    f"ttft {r.get('gen_ttft_ms', 0.0):.0f}ms  ")
         lines.append(
             f"p{p['process_index']:<3} step {p['last_step']:<6} "
             f"age {age if age is not None else '?':>7}s  "
@@ -733,7 +772,16 @@ def fleet_openmetrics() -> List[str]:
                 ("bigdl_fleet_hbm_peak_bytes", "hbm_peak_bytes",
                  "per-device compiled peak HBM per host"),
                 ("bigdl_fleet_hbm_live_bytes", "hbm_live_bytes",
-                 "live allocator peak bytes per host")]
+                 "live allocator peak bytes per host"),
+                ("bigdl_fleet_gen_tokens_total", "gen_tokens",
+                 "generated tokens per decode replica"),
+                ("bigdl_fleet_gen_tokens_s", "gen_tokens_s",
+                 "generated tokens/s per decode replica"),
+                ("bigdl_fleet_gen_ttft_ms", "gen_ttft_ms",
+                 "latest generation TTFT per decode replica"),
+                ("bigdl_fleet_gen_itl_p99_ms", "gen_itl_p99_ms",
+                 "latest generation p99 inter-token latency per "
+                 "decode replica")]
     for metric, field, help_ in per_host:
         lines.append(f"# HELP {metric} {help_}")
         lines.append(f"# TYPE {metric} gauge")

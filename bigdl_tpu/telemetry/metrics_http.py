@@ -81,6 +81,12 @@ class MetricsSink:
         self.serve_batches = 0
         self.serve_rows = 0
         self.last_serve: Dict[str, Any] = {}
+        # completed generations (kind "generate",
+        # serving/generate/batcher.py): token totals + the latest
+        # request's TTFT / inter-token tail — the decode-replica view
+        self.gen_requests = 0
+        self.gen_tokens = 0
+        self.last_gen: Dict[str, Any] = {}
         # per-collective comms attribution (kind "comms",
         # telemetry/comms.py): the latest per-step snapshot
         self.last_comms: Dict[str, Any] = {}
@@ -146,6 +152,12 @@ class MetricsSink:
                 self.last_serve = {k: event[k] for k in
                                    ("size", "queue_ms", "infer_ms",
                                     "fill") if k in event}
+            elif kind == "generate":
+                self.gen_requests += 1
+                self.gen_tokens += int(event.get("tokens", 0))
+                self.last_gen = {k: event[k] for k in
+                                 ("tokens", "ttft_ms", "itl_p99_ms",
+                                  "finish", "dur") if k in event}
             elif kind == "comms":
                 self.last_comms = {k: event[k] for k in
                                    ("count", "bytes", "payload_bytes",
@@ -200,6 +212,9 @@ class MetricsSink:
                     "serve_batches": self.serve_batches,
                     "serve_rows": self.serve_rows,
                     "last_serve": dict(self.last_serve),
+                    "gen_requests": self.gen_requests,
+                    "gen_tokens": self.gen_tokens,
+                    "last_gen": dict(self.last_gen),
                     "comms": dict(self.last_comms),
                     "memory": dict(self.last_memory)}
 
@@ -261,6 +276,19 @@ class MetricsSink:
                    self.serve_batches, "serving batches executed")
             sample("bigdl_serve_rows_total", "counter", self.serve_rows,
                    "serving rows (requests' samples) executed")
+            sample("bigdl_gen_tokens_total", "counter", self.gen_tokens,
+                   "tokens emitted by completed generations")
+            sample("bigdl_gen_requests_total", "counter",
+                   self.gen_requests, "completed generation requests")
+            if self.last_gen:
+                sample("bigdl_gen_ttft_ms", "gauge",
+                       self.last_gen.get("ttft_ms"),
+                       "latest completed generation's time to first "
+                       "token")
+                sample("bigdl_gen_itl_p99_ms", "gauge",
+                       self.last_gen.get("itl_p99_ms"),
+                       "latest completed generation's p99 inter-token "
+                       "latency")
             sample("bigdl_compiles_total", "counter", self.compiles,
                    "XLA compiles observed")
             sample("bigdl_compile_seconds_total", "counter",

@@ -54,6 +54,12 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # infer_ms / fill / requests travel as extra fields — the raw
     # material for `telemetry diff`'s serve_p50/p99/qps metrics
     "serve": {"size": (int,), "dur": _NUM},
+    # one per COMPLETED generation (serving/generate/batcher.py):
+    # tokens = emitted count, dur = submit-to-last-token seconds;
+    # ttft_ms / itl_p99_ms / finish / queue_ms travel as extra fields —
+    # the raw material for the bigdl_gen_* metrics and the fleet view's
+    # decode-replica columns
+    "generate": {"tokens": (int,), "dur": _NUM},
     # per-collective comms attribution (telemetry/comms.py): count =
     # collective ops in the compiled step, bytes = HloCostAnalysis-style
     # bytes accessed; payload_bytes / by_axis / by_op / rows /
@@ -85,6 +91,10 @@ STREAM_NAMES = frozenset({
     # span, server lifecycle instants, queue gauge, admission counters
     "serve/warmup", "serve/started", "serve/drain", "serve/load",
     "serve/queue_depth", "serve/requests", "serve/rejected",
+    # the LLM decode subsystem (serving/generate/, docs/serving.md
+    # "Autoregressive generation"): tokens-emitted counter per coalesced
+    # decode iteration, live active-sequence + KV-cache-occupancy gauges
+    "serve/generate", "serve/active_seqs", "serve/cache_occupancy",
     # instants
     "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
     "metrics/serving", "profile/armed", "profile/captured",
@@ -142,6 +152,10 @@ STREAM_NAMES = frozenset({
     "TrainStep.run", "TrainStep.run_sharded", "TrainStep.run_scan",
     "TrainStep.aot_scan", "EvalStep.run",
     "ServeExecutor.warmup", "ServeExecutor.compile",
+    # the generation executor's prefill/decode compiles split the same
+    # way: warmup names are paid once at startup, the in-request-path
+    # name never appears in a healthy server
+    "GenerateExecutor.warmup", "GenerateExecutor.compile",
 })
 
 

@@ -34,6 +34,13 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           "bigdl_tpu/serving/executor.py",
           "bigdl_tpu/serving/batcher.py",
           "bigdl_tpu/serving/server.py",
+          # the LLM decode subsystem (ISSUE 13): KV cache + prefill/
+          # decode executables + generation batching — a silent drop
+          # reverts generation to one full-context forward per token
+          # and loses the /v1/generate streaming surface
+          "bigdl_tpu/serving/generate/kv_cache.py",
+          "bigdl_tpu/serving/generate/decode.py",
+          "bigdl_tpu/serving/generate/batcher.py",
           # compile-time war (ISSUE 9): scan-over-layers + the managed
           # persistent compile cache — a silent drop reverts models to
           # N-times-unrolled lowering and unmeasured cache traffic
@@ -142,7 +149,9 @@ def test_registry_names_are_not_stale():
                       # serving compile events carry their name through
                       # a variable (warmup vs in-request-path), so the
                       # lexical scan can't see the literals
-                      "ServeExecutor.warmup", "ServeExecutor.compile"}
+                      "ServeExecutor.warmup", "ServeExecutor.compile",
+                      "GenerateExecutor.warmup",
+                      "GenerateExecutor.compile"}
     stale = sorted(set(schema.STREAM_NAMES) - names - allowed_unseen)
     assert stale == [], (
         f"STREAM_NAMES entries with no emitter found: {stale} — "

@@ -69,6 +69,13 @@ PINNED_MODULES = [
     "bigdl_tpu/serving/executor.py",
     "bigdl_tpu/serving/batcher.py",
     "bigdl_tpu/serving/server.py",
+    # the LLM decode subsystem (ISSUE 13): losing kv_cache.py breaks
+    # the trace-order cache contract silently (decode would recompute
+    # full context); losing decode.py/batcher.py drops /v1/generate and
+    # reverts generation to one full forward per token
+    "bigdl_tpu/serving/generate/kv_cache.py",
+    "bigdl_tpu/serving/generate/decode.py",
+    "bigdl_tpu/serving/generate/batcher.py",
     # compile-time war (ISSUE 9): losing scan.py silently reverts the
     # registry models to N-times-unrolled lowering; losing
     # compile_cache.py blinds the persistent cache (hits/misses/compile

@@ -103,6 +103,17 @@ if srv:
              f" compiles={srv.get('compiles', '?')}")
     if srv.get("rejected"):
         line += f" rejected={srv['rejected']}"
+    # the LLM decode path (serving/generate/): live token rate, TTFT,
+    # and decode-slot pressure — a babysitter sees a TTFT spike or a
+    # full decode batch (admissions queueing behind max_active) without
+    # curling /v1/generate (docs/serving.md runbook entry)
+    gen = srv.get("generate") or {}
+    if gen:
+        line += (f" gen={gen.get('tokens_s', 0)}tok/s"
+                 f" ttft={gen.get('ttft_p50_ms', '?')}ms"
+                 f" active={gen.get('active_seqs', 0)}"
+                 f"/{gen.get('max_active', '?')}"
+                 f" cache={gen.get('cache_occupancy', 0)}")
     if srv.get("draining"):
         line += " DRAINING"
 # cluster fault tolerance (parallel/cluster.py): the per-peer heartbeat
