@@ -40,10 +40,24 @@ latency — the decode-step tail).  All four are diff-gated:
 zero-slack ``steady_compiles``/``retrace_diagnostics`` counters hold —
 a decode executable compiling mid-stream is a frozen token stream.
 
+``--slo-p99-ms`` / ``--slo-ttft-ms`` declare latency budgets
+(telemetry/request_trace.py SLOTracker): the server tracks its windowed
+p99 (and TTFT p99) against them live, the worst 32 violators by
+budget overshoot keep their trace ids (``VIOLATING_KEEP`` — worst-first,
+not newest, so a sustained burn cannot evict its own catastrophic
+evidence), and the harness **exits 4 when a budget is burned**
+(observed p99 > budget) — the same exit code as ``--diff-against``, so
+CI treats a blown SLO exactly like a regression.  The bench JSON row
+carries the full SLO ledger (burn rates + the violating requests' trace
+ids), so the failing artifact names its own evidence: feed any id to
+``GET /v1/trace/<id>`` on a live server or ``python -m
+bigdl_tpu.telemetry trace run.jsonl --id <id>`` offline.
+
 Usage::
 
     python bench_serving.py --model lenet --qps 100 --duration 10
     python bench_serving.py --model lenet --diff-against BENCH_serving.json
+    python bench_serving.py --model lenet --qps 100 --slo-p99-ms 50
     python bench_serving.py --model transformer --generate --qps 5 \
         --duration 10 --gen-mix 8,24,64 --max-new-tokens 16
 """
@@ -288,6 +302,15 @@ def main(argv=None) -> int:
                     help="compare against a prior bench_serving JSON "
                          "(telemetry diff); exit 4 on regression")
     ap.add_argument("--diff-threshold-pct", type=float, default=None)
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    metavar="MS",
+                    help="declared request-latency p99 budget: exit 4 "
+                         "when the observed p99 exceeds it; violating "
+                         "requests' trace ids land in the bench JSON")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    metavar="MS",
+                    help="--generate: declared time-to-first-token p99 "
+                         "budget (same exit-4 gate)")
     args = ap.parse_args(argv)
 
     from bigdl_tpu import telemetry
@@ -329,7 +352,8 @@ def main(argv=None) -> int:
             seq_buckets=seq_buckets,
             generate=args.generate,
             decode_buckets=buckets(args.decode_buckets),
-            cache_buckets=buckets(args.cache_buckets))
+            cache_buckets=buckets(args.cache_buckets),
+            slo_p99_ms=args.slo_p99_ms, slo_ttft_ms=args.slo_ttft_ms)
         print(f"# serving {args.model} on :{server.port}, "
               f"{server.executor.compile_count} buckets warm "
               f"({server.executor.warmup_s:.1f}s)",
@@ -377,6 +401,12 @@ def main(argv=None) -> int:
                 max_batch=args.max_batch,
                 max_wait_ms=args.max_wait_ms, int8=bool(args.int8),
                 server=server.status())
+            if server.slo.active():
+                # the SLO ledger travels IN the bench artifact: burn
+                # rates plus the worst violators' trace ids — the
+                # failing JSON names its own evidence
+                row["slo"] = server.slo.status()
+                row["slo_violations"] = server.slo.violations
         finally:
             server.stop(drain=True)
     if owned_log:
@@ -395,6 +425,22 @@ def main(argv=None) -> int:
     print(json.dumps(line))
     sys.stdout.flush()
 
+    slo_burned = []
+    if args.slo_p99_ms is not None or args.slo_ttft_ms is not None:
+        burn = (row.get("slo") or {}).get("burn") or {}
+        slo_burned = [
+            which for which, b in sorted(burn.items())
+            if (b or {}).get("burn") is not None and b["burn"] > 1.0]
+        if slo_burned:
+            violating = (row.get("slo") or {}).get("violating") or []
+            ids = [v.get("trace_id") for v in violating]
+            print(f"SLO VIOLATED ({', '.join(slo_burned)}): "
+                  + "  ".join(
+                      f"{w} {burn[w]['observed_ms']}ms observed vs "
+                      f"{burn[w]['budget_ms']}ms budget "
+                      f"(burn {burn[w]['burn']}x)" for w in slo_burned)
+                  + f"; violating trace ids: {ids}", file=sys.stderr)
+
     if args.diff_against:
         from bigdl_tpu.telemetry import diff as tdiff
 
@@ -411,6 +457,8 @@ def main(argv=None) -> int:
             return 2
         if any(r["regressed"] for r in rows):
             return 4  # the sweep ran; it's just slower — bench.py's code
+    if slo_burned:
+        return 4  # the sweep ran; it blew its declared budget
     return 0
 
 

@@ -406,7 +406,8 @@ def cmd_serve(args) -> None:
             generate=args.generate,
             decode_buckets=_buckets(args.decode_buckets),
             cache_buckets=_buckets(args.cache_buckets),
-            max_new_tokens_limit=args.max_new_tokens_limit)
+            max_new_tokens_limit=args.max_new_tokens_limit,
+            slo_p99_ms=args.slo_p99_ms, slo_ttft_ms=args.slo_ttft_ms)
         # readiness line AFTER warmup: every bucket is compiled once
         # this prints — tests and load balancers key off it
         gen = ""
@@ -608,6 +609,16 @@ def main(argv=None) -> None:
                          " model's max_len)")
     se.add_argument("--max-new-tokens-limit", type=int, default=1024,
                     help="--generate: per-request max_new_tokens cap")
+    se.add_argument("--slo-p99-ms", type=float, default=None,
+                    metavar="MS",
+                    help="declared request-latency p99 budget: live "
+                         "burn-rate gauges on /metrics + /status.slo, "
+                         "violating requests keep their trace ids "
+                         "(docs/observability.md)")
+    se.add_argument("--slo-ttft-ms", type=float, default=None,
+                    metavar="MS",
+                    help="--generate: declared time-to-first-token "
+                         "p99 budget (same burn accounting)")
     se.set_defaults(fn=cmd_serve)
 
     sv = sub.add_parser("supervise",

@@ -48,20 +48,30 @@ class Request:
     lands; ``output``/``error`` hold the result.  ``cancel()`` (the
     frontend's timeout path) tells the worker to DROP the rows instead
     of computing results nobody will read — under overload, timed-out
-    work must not amplify the overload."""
+    work must not amplify the overload.
 
-    __slots__ = ("x", "rows", "enqueued_at", "done", "output", "error",
-                 "queue_ms", "cancelled")
+    ``trace`` (optional, telemetry/request_trace.py): the server's
+    RequestTrace riding along; ``dispatch`` is filled by the worker with
+    the carrying batch's split (epoch start, infer ms, bucket, padded
+    rows, co-batched requests, in-path compile ms) so the server can
+    tile the request's wall time into owned spans after ``wait()``."""
 
-    def __init__(self, x: np.ndarray):
+    __slots__ = ("x", "rows", "enqueued_at", "enqueued_ts", "done",
+                 "output", "error", "queue_ms", "cancelled", "trace",
+                 "dispatch")
+
+    def __init__(self, x: np.ndarray, trace=None):
         self.x = x
         self.rows = int(x.shape[0])
         self.enqueued_at = time.perf_counter()
+        self.enqueued_ts = time.time()  # epoch twin (span timestamps)
         self.done = threading.Event()
         self.output: Any = None
         self.error: Optional[BaseException] = None
         self.queue_ms: float = 0.0
         self.cancelled = False
+        self.trace = trace
+        self.dispatch: Optional[dict] = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -120,13 +130,32 @@ class ContinuousBatcher:
                                         daemon=True)
         self._thread.start()
 
+    @property
+    def runner(self):
+        return self._runner
+
+    @runner.setter
+    def runner(self, fn) -> None:
+        # executors expose their dispatch split (bucket, padded rows,
+        # in-path compile, device ms) through a `record` kwarg — detect
+        # on every assignment (tests and wrappers swap `.runner` live)
+        # so plain callables keep working
+        self._runner = fn
+        try:
+            import inspect
+
+            self._runner_records = "record" in \
+                inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            self._runner_records = False
+
     # -- admission ---------------------------------------------------------
-    def submit(self, x: np.ndarray) -> Request:
+    def submit(self, x: np.ndarray, trace=None) -> Request:
         """Enqueue ``[k, ...]`` rows; raises :class:`QueueFullError` at
         capacity or once draining."""
         if self._draining or self._stopped.is_set():
             raise QueueFullError("server is draining")
-        req = Request(np.asarray(x))
+        req = Request(np.asarray(x), trace=trace)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -219,9 +248,11 @@ class ContinuousBatcher:
         if not batch:
             return
         t0 = time.perf_counter()
+        t0_ts = time.time()
         rows = sum(r.rows for r in batch)
         for r in batch:
             r.queue_ms = (t0 - r.enqueued_at) * 1000.0
+        rec: dict = {}
         try:
             xs = [r.x for r in batch]
             lens = [x.shape[1] if np.ndim(x) >= 2 else None for x in xs]
@@ -229,8 +260,18 @@ class ContinuousBatcher:
             if self._seq_pad is not None:
                 xs, target = self._seq_pad(xs)
             x = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
-            out = self.runner(x)
+            if self._runner_records:
+                out = self.runner(x, record=rec)
+            else:
+                out = self.runner(x)
             infer_ms = (time.perf_counter() - t0) * 1000.0
+            for r in batch:
+                # the carrying batch's split, per rider — the server
+                # tiles each request's wall time from this after wait()
+                r.dispatch = dict(rec, t0_ts=t0_ts,
+                                  infer_ms=round(infer_ms, 3),
+                                  co_requests=len(batch),
+                                  batch_rows=rows)
             offset = 0
             for i, r in enumerate(batch):
                 sliced = _slice_rows(out, offset, offset + r.rows)
@@ -264,9 +305,15 @@ class ContinuousBatcher:
                 r.done.set()
         tracer = _telemetry.get()
         if tracer is not None:
+            # queue_ms is anchored at the OLDEST rider (the worst case
+            # the deadline contract bounds); min/mean travel beside it
+            # so aggregate readers no longer overstate the typical wait
+            waits = [r.queue_ms for r in batch]
             tracer.emit("serve", size=rows, requests=len(batch),
                         dur=(time.perf_counter() - t0),
-                        queue_ms=round(max(r.queue_ms for r in batch), 3),
+                        queue_ms=round(max(waits), 3),
+                        queue_ms_min=round(min(waits), 3),
+                        queue_ms_mean=round(sum(waits) / len(waits), 3),
                         infer_ms=round(infer_ms, 3),
                         fill=round(rows / self.max_batch, 4))
             _telemetry.gauge("serve/queue_depth", self._q.qsize())

@@ -326,11 +326,17 @@ class BucketedExecutor:
             sb = self.policy.seq_bucket(x.shape[1])
         return bb, sb
 
-    def run(self, x) -> Any:
+    def run(self, x, record: Optional[Dict[str, Any]] = None) -> Any:
         """Pad ``[n, ...]`` onto its bucket, dispatch the warm
         executable (compiling it first if cold — emitted as the
         in-request-path ``ServeExecutor.compile``), slice the padding
-        back off.  Returns the output pytree as numpy."""
+        back off.  Returns the output pytree as numpy.
+
+        ``record`` (request tracing, telemetry/request_trace.py): a dict
+        the dispatch fills with its own split — bucket, padded rows,
+        in-path ``compile_ms`` (zero on a warm bucket) and ``device_ms``
+        — so the batcher can attribute each rider's wall time without
+        re-deriving bucket selection."""
         import jax.numpy as jnp
 
         x = np.asarray(x)
@@ -341,6 +347,7 @@ class BucketedExecutor:
                + (f"s{key[1]}]" if key[1] is not None else "]")
         if _hooks.hooks_active():
             _hooks.dispatch_event(self, kind, {"x": padded})
+        compile_ms = 0.0
         with self._lock:
             if self._state is None:
                 self.refresh_state()
@@ -348,14 +355,29 @@ class BucketedExecutor:
             if compiled is None:
                 import jax
 
+                t_c0 = time.perf_counter()
                 spec = jax.ShapeDtypeStruct(padded.shape, padded.dtype)
                 compiled = self._compile(key, spec, "ServeExecutor.compile")
+                compile_ms = (time.perf_counter() - t_c0) * 1000.0
         xj = self._place_input(jnp.asarray(padded))
+        t_d0 = time.perf_counter()
         try:
             out = compiled(self._state, xj)
         except Exception as e:  # noqa: BLE001 - OOM forensics only
             self._maybe_raise_oom(e, kind)
             raise
+        if record is not None:
+            import jax
+
+            # dispatch is async: block before stamping device_ms so the
+            # number is the compute, not the enqueue (the host-side
+            # np.asarray conversion below would have blocked anyway)
+            jax.block_until_ready(out)
+            record.update(
+                bucket=key[0], seq_bucket=key[1], rows=n,
+                padded_rows=key[0] - n, compile_ms=round(compile_ms, 3),
+                device_ms=round(
+                    (time.perf_counter() - t_d0) * 1000.0, 3))
         if _hooks.hooks_active():
             # one executable per kind, forever — the detector sees a
             # constant signature AND a constant cache size per bucket

@@ -87,23 +87,31 @@ class GenerationRequest:
     handler drains them via :meth:`events`.  ``cancel()`` (client gone)
     tells the scheduler to free the row at the next iteration instead
     of decoding for nobody.
+
+    ``trace`` (optional, telemetry/request_trace.py): the server's
+    RequestTrace riding along.  The worker stamps it live — queue wait,
+    the prefill span, every decode iteration it rode (with that
+    iteration's co-batch size) and per-token emit times — and the
+    server's retire hook closes it out.
     """
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
                  "seed", "eos_token", "rng", "stream", "done", "error",
-                 "tokens", "enqueued_at", "first_token_at",
-                 "last_token_at", "itl_ms", "cancelled", "finish_reason",
-                 "queue_ms")
+                 "tokens", "enqueued_at", "enqueued_ts",
+                 "first_token_at", "last_token_at", "itl_ms",
+                 "cancelled", "finish_reason", "queue_ms", "trace")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0, eos_token: Optional[int] = None):
+                 seed: int = 0, eos_token: Optional[int] = None,
+                 trace=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must carry at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.prompt = prompt
+        self.trace = trace
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -118,6 +126,7 @@ class GenerationRequest:
         self.error: Optional[str] = None
         self.tokens: List[int] = []
         self.enqueued_at = time.perf_counter()
+        self.enqueued_ts = time.time()  # epoch twin (span timestamps)
         self.first_token_at: Optional[float] = None
         self.last_token_at: Optional[float] = None
         self.itl_ms: List[float] = []
@@ -134,6 +143,8 @@ class GenerationRequest:
             self.itl_ms.append((now - self.last_token_at) * 1000.0)
         self.last_token_at = now
         self.tokens.append(int(token))
+        if self.trace is not None:
+            self.trace.note_token(time.time())
         self.stream.put(("token", int(token), now))
 
     def ttft_ms(self) -> Optional[float]:
@@ -208,12 +219,18 @@ class GenerationBatcher:
     batcher: a bounded waiting queue, :class:`QueueFullError` past
     capacity or once draining, and ``stop(drain=True)`` finishes every
     in-flight generation before parking (the SIGTERM path).
+
+    ``on_retire`` (request tracing): called with every request exactly
+    once at its terminal transition — finished, cancelled, or failed —
+    so the server can close out its trace; exceptions in the hook never
+    kill the worker.
     """
 
     def __init__(self, executor, max_wait_ms: float = 2.0,
                  queue_limit: int = 64,
-                 eos_token: Optional[int] = None):
+                 eos_token: Optional[int] = None, on_retire=None):
         self.executor = executor
+        self._on_retire = on_retire
         self.max_active = executor.max_active
         self.max_wait_s = max(0.0, max_wait_ms) / 1000.0
         self.queue_limit = queue_limit
@@ -243,8 +260,8 @@ class GenerationBatcher:
     # -- admission ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
-               seed: int = 0,
-               eos_token: Optional[int] = None) -> GenerationRequest:
+               seed: int = 0, eos_token: Optional[int] = None,
+               trace=None) -> GenerationRequest:
         """Enqueue one generation; raises :class:`QueueFullError` at
         capacity or once draining."""
         if self._draining or self._stopped.is_set():
@@ -263,7 +280,8 @@ class GenerationBatcher:
                                 temperature=temperature, top_k=top_k,
                                 seed=seed,
                                 eos_token=eos_token if eos_token
-                                is not None else self.eos_token)
+                                is not None else self.eos_token,
+                                trace=trace)
         largest = self.executor.cache_buckets[-1]
         if req.prompt.size >= largest:
             raise ValueError(
@@ -334,6 +352,7 @@ class GenerationBatcher:
                 continue
             if req.cancelled:
                 req.finish("cancelled")
+                self._notify_retire(req)
                 continue
             if deadline is None:
                 deadline = req.enqueued_at + self.max_wait_s
@@ -353,20 +372,30 @@ class GenerationBatcher:
         if not newcomers:
             return
         t0 = time.perf_counter()
+        t0_ts = time.time()
         lengths = [r.prompt.size for r in newcomers]
         smax = max(lengths)
         tokens = np.zeros((len(newcomers), smax), np.int32)
         for i, r in enumerate(newcomers):
             tokens[i, :lengths[i]] = r.prompt
             r.queue_ms = (t0 - r.enqueued_at) * 1000.0
+            if r.trace is not None:
+                r.trace.add_span("queue_wait", r.enqueued_ts, r.queue_ms,
+                             component="queue_wait",
+                             co_admitted=len(newcomers))
+        rec: Dict[str, Any] = {}
         try:
-            logits, caches = self.executor.prefill(tokens, lengths)
+            logits, caches = self.executor.prefill(tokens, lengths,
+                                                   record=rec)
         except BaseException as e:  # noqa: BLE001 - relayed per request
             with self._stats_lock:
                 self.errors += len(newcomers)
             for req in newcomers:
                 req.fail(f"{type(e).__name__}: {e}")
+                self._notify_retire(req)
             return
+        prefill_ms = (time.perf_counter() - t0) * 1000.0
+        self._stamp_prefill(newcomers, t0_ts, prefill_ms, rec)
         rows: List[_Row] = []
         kept: List[int] = []
         for i, req in enumerate(newcomers):
@@ -379,6 +408,7 @@ class GenerationBatcher:
                 with self._stats_lock:
                     self.errors += 1
                 req.fail(f"{type(e).__name__}: {e}")
+                self._notify_retire(req)
                 continue
             req.emit(tok)  # the TTFT token, straight off the prefill
             rows.append(_Row(req, lengths[i], tok))
@@ -400,6 +430,42 @@ class GenerationBatcher:
         # a prompt already at its cache ceiling finishes on the TTFT
         # token alone (nowhere to write the next k/v row)
         self._retire(self._finished_rows())
+
+    def _stamp_prefill(self, newcomers: List[GenerationRequest],
+                       t0_ts: float, prefill_ms: float, rec: dict
+                       ) -> None:
+        """Tile one prefill dispatch onto the traces that paid for it:
+        each newcomer owns a (compile, prefill-compute, padding) split
+        of the wall, and every ALREADY-ACTIVE row lost the whole
+        dispatch to somebody else's prefill — the blame component
+        ``prefill_interference`` (decode stalls while the worker
+        prefills; a prefill flood shows up HERE, not as compute)."""
+        from bigdl_tpu.telemetry import request_trace as _rt
+
+        for r in newcomers:
+            if r.trace is None:
+                continue
+            _rt.stamp_dispatch_spans(
+                r.trace, t0_ts, prefill_ms, rec, "prefill",
+                default_bucket=len(newcomers),
+                co_prefill=len(newcomers),
+                seq_bucket=rec.get("seq_bucket"))
+        for row in self._active:
+            tr = row.req.trace
+            if tr is not None:
+                tr.add_span("prefill_interference", t0_ts, prefill_ms,
+                        component="prefill_interference",
+                        newcomers=len(newcomers))
+
+    def _notify_retire(self, req: GenerationRequest) -> None:
+        """Terminal-transition hook (the server's trace close-out);
+        an observer must never kill the worker."""
+        if self._on_retire is None:
+            return
+        try:
+            self._on_retire(req)
+        except Exception:  # noqa: BLE001 - observers stay observers
+            pass
 
     def _rebuild(self, sources) -> None:
         if not self._active:
@@ -459,6 +525,7 @@ class GenerationBatcher:
                 # a failed row's terminal "error" event already went
                 # out via fail(); retiring it only frees the slot
                 req.finish(req.finish_reason or "stop")
+            self._notify_retire(req)
             if tracer is not None:
                 tracer.emit("generate", tokens=st["n_tokens"],
                             dur=st["dur_s"], ttft_ms=st["ttft_ms"],
@@ -470,7 +537,27 @@ class GenerationBatcher:
         """One coalesced decode iteration over every active row."""
         stack = self._stack
         tokens = [row.last_token for row in self._active]
-        logits = self.executor.decode(stack, tokens)
+        t0 = time.perf_counter()
+        t0_ts = time.time()
+        rec: Dict[str, Any] = {}
+        logits = self.executor.decode(stack, tokens, record=rec)
+        decode_ms = (time.perf_counter() - t0) * 1000.0
+        compile_ms = float(rec.get("compile_ms", 0.0) or 0.0)
+        co_batch = len(self._active)
+        iter_ms = max(0.0, decode_ms - compile_ms)
+        for row in self._active:
+            tr = row.req.trace
+            if tr is None:
+                continue
+            # every rider pays this iteration's wall; the co-batch size
+            # travels with it so the retire hook can split out
+            # co_batch_stall against the endpoint's typical iteration
+            if compile_ms:
+                tr.add_span("compile", t0_ts, compile_ms,
+                        component="compile")
+            tr.add_span("decode", t0_ts + compile_ms / 1000.0, iter_ms,
+                    component="compute", co_batch=co_batch)
+            tr.note_iter(iter_ms, co_batch)
         emitted = 0
         for i, row in enumerate(self._active):
             # the executor scattered row i's token at position length;
@@ -515,6 +602,7 @@ class GenerationBatcher:
             self.errors += len(self._active)
         for row in self._active:
             row.req.fail(message)
+            self._notify_retire(row.req)
         self._active = []
         self._stack = None
         self._publish_gauges()
@@ -523,9 +611,11 @@ class GenerationBatcher:
         self._fail_active(message)
         while True:
             try:
-                self._q.get_nowait().fail(message)
+                req = self._q.get_nowait()
             except queue.Empty:
                 return
+            req.fail(message)
+            self._notify_retire(req)
 
     # -- stats / lifecycle -------------------------------------------------
     def stats(self, window_s: float = 60.0) -> Dict[str, Any]:
@@ -577,7 +667,9 @@ class GenerationBatcher:
         # answer — the worker is dead here, so failing them is race-free
         while True:
             try:
-                self._q.get_nowait().fail("server stopped")
+                req = self._q.get_nowait()
             except queue.Empty:
                 break
+            req.fail("server stopped")
+            self._notify_retire(req)
         return parked

@@ -286,17 +286,21 @@ class GenerateExecutor(BucketedExecutor):
         return self.warmup_s
 
     # -- dispatch ----------------------------------------------------------
-    def _run_key(self, key, kind: str, args: tuple):
+    def _run_key(self, key, kind: str, args: tuple,
+                 record: Optional[Dict[str, Any]] = None):
         if _hooks.hooks_active():
             _hooks.dispatch_event(self, kind,
                                   {"tokens": args[1], "lengths": args[2]})
+        compile_ms = 0.0
         with self._lock:
             if self._state is None:
                 self.refresh_state()
             compiled = self._exec.get(key)
             if compiled is None:
+                t_c0 = time.perf_counter()
                 compiled = self._compile_gen(key,
                                              "GenerateExecutor.compile")
+                compile_ms = (time.perf_counter() - t_c0) * 1000.0
         try:
             out = compiled(self._state, *args[1:])
         except Exception as e:  # noqa: BLE001 - OOM forensics only
@@ -304,6 +308,11 @@ class GenerateExecutor(BucketedExecutor):
             raise
         if _hooks.hooks_active():
             _hooks.cache_event(self, kind, 1)
+        if record is not None:
+            # request tracing (telemetry/request_trace.py): an in-path
+            # compile here is exactly the blame component "compile" —
+            # a healthy warm server never fills this
+            record["compile_ms"] = round(compile_ms, 3)
         return out
 
     def prefill_buckets(self, n_rows: int, seq_len: int) -> Tuple[int, int]:
@@ -311,7 +320,8 @@ class GenerateExecutor(BucketedExecutor):
         s = self.policy.seq_bucket(seq_len)
         return b, s
 
-    def prefill(self, tokens: np.ndarray, lengths: Sequence[int]):
+    def prefill(self, tokens: np.ndarray, lengths: Sequence[int],
+                record: Optional[Dict[str, Any]] = None):
         """``[n, s]`` prompt rows (ragged tails padded by the caller's
         bucket choice) -> ``(last-position logits [n, V] numpy,
         per-layer caches [B, H, S, D] on device)``."""
@@ -327,10 +337,14 @@ class GenerateExecutor(BucketedExecutor):
         kind = f"GenerateExecutor.prefill[b{b}s{s}]"
         logits, caches = self._run_key(
             key, kind, (self._state, jnp.asarray(padded),
-                        jnp.asarray(lens)))
+                        jnp.asarray(lens)), record=record)
+        if record is not None:
+            record.update(bucket=b, seq_bucket=s, rows=n,
+                          padded_rows=b - n)
         return np.asarray(logits)[:n], caches
 
-    def decode(self, stack: "_kv.StackedKVCache", tokens: np.ndarray):
+    def decode(self, stack: "_kv.StackedKVCache", tokens: np.ndarray,
+               record: Optional[Dict[str, Any]] = None):
         """One coalesced decode step over ``stack``'s live rows.
         ``tokens``: ``[n_rows]`` last emitted token per row.  Updates
         ``stack.layers`` in place (the scatter-written caches) and
@@ -353,7 +367,7 @@ class GenerateExecutor(BucketedExecutor):
         logits, new_caches = self._run_key(
             key, kind, (self._state, jnp.asarray(tok),
                         jnp.asarray(stack.lengths_padded()),
-                        stack.layers))
+                        stack.layers), record=record)
         stack.layers = new_caches
         return np.asarray(logits)[:stack.n_rows]
 

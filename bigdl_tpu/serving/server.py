@@ -46,9 +46,11 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from bigdl_tpu import telemetry as _telemetry
-from bigdl_tpu.serving.batcher import ContinuousBatcher, QueueFullError
+from bigdl_tpu.serving.batcher import (ContinuousBatcher, QueueFullError,
+                                       Request)
 from bigdl_tpu.serving.buckets import BucketPolicy
 from bigdl_tpu.serving.executor import executor_for
+from bigdl_tpu.telemetry import request_trace as _rt
 
 __all__ = ["ModelServer", "serve_model", "get"]
 
@@ -88,13 +90,35 @@ class ModelServer:
                  request_timeout_s: float = 30.0,
                  generate: bool = False, decode_buckets=None,
                  cache_buckets=None, eos_token: Optional[int] = None,
-                 max_new_tokens_limit: int = 1024):
+                 max_new_tokens_limit: int = 1024,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_ttft_ms: Optional[float] = None):
+        from bigdl_tpu.utils.config import get_config
+
         self.model = model.evaluate()
         self.name = name
         self.sample_shape: Tuple[int, ...] = tuple(input_spec.shape[1:])
         self.dtype = np.dtype(input_spec.dtype)
         self.request_timeout_s = request_timeout_s
         self.max_new_tokens_limit = max_new_tokens_limit
+        # request-level tracing (telemetry/request_trace.py): every
+        # admitted request gets a trace id + span timeline; the store
+        # keeps the recent ring AND the slowest-k per endpoint
+        cfg = get_config()
+        self.traces: Optional[_rt.TraceStore] = (
+            _rt.TraceStore(ring=cfg.trace_ring,
+                           slowest_k=cfg.trace_slowest)
+            if cfg.trace_requests else None)
+        self._trace_spans = cfg.trace_spans
+        self.slo = _rt.SLOTracker(p99_ms=slo_p99_ms,
+                                  ttft_ms=slo_ttft_ms)
+        self._hist: Dict[str, _rt.LatencyHistogram] = {
+            "predict": _rt.LatencyHistogram(),
+            "generate": _rt.LatencyHistogram(),
+            "ttft": _rt.LatencyHistogram()}
+        self._baselines: Dict[str, _rt.ComponentBaseline] = {
+            "predict": _rt.ComponentBaseline(),
+            "generate": _rt.ComponentBaseline()}
         seq_axis = 1 if seq_buckets else None
         policy = BucketPolicy(max_batch=max_batch,
                               batch_buckets=batch_buckets,
@@ -118,7 +142,8 @@ class ModelServer:
                 cache_buckets=cache_buckets)
             self.gen_batcher = GenerationBatcher(
                 self.executor, max_wait_ms=max_wait_ms,
-                queue_limit=queue_limit, eos_token=eos_token)
+                queue_limit=queue_limit, eos_token=eos_token,
+                on_retire=self._finish_generate_trace)
         else:
             self.executor = executor_for(model, mesh=mesh, policy=policy,
                                          compute_dtype=compute_dtype,
@@ -243,10 +268,11 @@ class ModelServer:
             out["eos_token"] = int(payload["eos_token"])
         return out, bool(payload.get("stream", True))
 
-    def predict(self, arr: np.ndarray) -> Tuple[Any, float]:
-        """Submit rows and wait for the carrying batch; returns
-        (outputs, queue_ms).  Raises QueueFullError / TimeoutError."""
-        req = self.batcher.submit(arr)
+    def predict(self, arr: np.ndarray, trace=None) -> Request:
+        """Submit rows and wait for the carrying batch; returns the
+        completed :class:`Request` (``output``/``queue_ms``/``dispatch``
+        filled).  Raises QueueFullError / TimeoutError."""
+        req = self.batcher.submit(arr, trace=trace)
         if not req.wait(self.request_timeout_s):
             # nobody will read the answer: tell the worker to DROP the
             # rows — under overload, timed-out work must not keep the
@@ -256,7 +282,229 @@ class ModelServer:
                 f"no dispatch within {self.request_timeout_s}s")
         if req.error is not None:
             raise req.error
-        return req.output, req.queue_ms
+        return req
+
+    # -- request tracing ---------------------------------------------------
+    def start_trace(self, endpoint: str,
+                    header_id: Optional[str] = None
+                    ) -> Tuple[str, Optional["_rt.RequestTrace"]]:
+        """Mint-or-propagate the trace id (the ``X-Request-Id``
+        contract: a valid client id is kept and echoed, anything else
+        replaced) and open a trace when recording is on.  The id is
+        echoed even with tracing off — propagation is the contract,
+        recording the observer."""
+        tid = header_id if _rt.valid_id(header_id) else _rt.mint_id()
+        trace = (_rt.RequestTrace(tid, endpoint,
+                                  max_spans=self._trace_spans)
+                 if self.traces is not None else None)
+        return tid, trace
+
+    def _emit_request(self, trace: "_rt.RequestTrace",
+                      violated=None) -> None:
+        tracer = _telemetry.get()
+        if tracer is None:
+            return
+        doc = trace.to_dict()
+        if violated:
+            doc["slo_violated"] = list(violated)
+        if self.slo.p99_ms is not None:
+            doc["slo_p99_ms"] = self.slo.p99_ms
+        if self.slo.ttft_ms is not None:
+            doc["slo_ttft_ms"] = self.slo.ttft_ms
+        tracer.emit("request", **doc)
+
+    def finish_rejected(self, trace: Optional["_rt.RequestTrace"],
+                        reason: str, endpoint: str = "predict",
+                        trace_id: Optional[str] = None,
+                        wall_ms: Optional[float] = None) -> None:
+        """Terminal-span trace for a rejected/expired request (429
+        queue_full, 503 draining, 504 dispatch_timeout) — rejection
+        spikes stay diagnosable post-hoc, per reason.
+
+        Budget accounting splits by reason: a 429/503 rejection is
+        instant and deliberately stays OUT of the latency distribution
+        (its ~0 ms wall would dilute the observed p99 DOWN and mask
+        burn), but a 504 dispatch timeout is the opposite — the client
+        waited the full ``wall_ms`` — so its wall enters the SLO burn
+        and histograms; the requests that blew the budget are exactly
+        the ones the gate must see.  Runs with tracing off too
+        (``trace`` None): budgets burn regardless of recording."""
+        violated = None
+        if reason == "dispatch_timeout" and wall_ms is not None:
+            violated = self._observe_budgets(
+                endpoint, wall_ms,
+                trace.trace_id if trace is not None
+                else (trace_id or "untraced"))
+        if trace is None:
+            return
+        trace.finish("rejected", reason)
+        rem = max(0.0, (trace.total_ms or 0.0) - trace.span_sum_ms())
+        trace.add_span("rejected", trace.finished_at - rem / 1000.0, rem,
+                   reason=reason)
+        if violated:
+            trace.attrs["slo_violated"] = violated
+        self.traces.add(trace)
+        self._emit_request(trace)
+
+    def finish_failed(self, trace: Optional["_rt.RequestTrace"],
+                      message: str, endpoint: str = "predict",
+                      trace_id: Optional[str] = None,
+                      wall_ms: Optional[float] = None) -> None:
+        """Terminal trace for a request whose dispatch raised (the 500
+        path) — the requests most in need of post-hoc evidence are the
+        ones that failed server-side.  Their walls are real waiting the
+        client did, so they enter the SLO burn + histograms (matching
+        the generate path, where errored requests land through the
+        retire hook) — with or without a recorded trace."""
+        violated = None
+        if wall_ms is not None:
+            violated = self._observe_budgets(
+                endpoint, wall_ms,
+                trace.trace_id if trace is not None
+                else (trace_id or "untraced"))
+        if trace is None:
+            return
+        trace.finish("error", message)
+        self._close_books(trace)
+        if violated:
+            trace.attrs["slo_violated"] = violated
+        self.traces.add(trace)
+        self._emit_request(trace)
+
+    def _observe_budgets(self, endpoint: str, ms: Optional[float],
+                         trace_id: str,
+                         ttft_ms: Optional[float] = None) -> list:
+        """Latency histograms + SLO burn accounting for one completed
+        request.  Deliberately independent of trace RECORDING: with
+        ``BIGDL_TRACE=off`` the waterfalls go dark, but the declared
+        budgets keep burning and the bench gate keeps gating."""
+        hist = self._hist.get(endpoint)
+        if hist is not None and ms is not None:
+            hist.observe(ms)
+        if ttft_ms is not None:
+            self._hist["ttft"].observe(ttft_ms)
+        violated = self.slo.observe(ms, trace_id, ttft_ms=ttft_ms)
+        self.slo.maybe_gauges()
+        return violated
+
+    def _finish_predict_trace(self, trace: Optional["_rt.RequestTrace"],
+                              req: Request, respond_ms: float,
+                              wall_ms: Optional[float] = None) -> None:
+        """Tile one predict request's wall time into owned spans off
+        the worker's dispatch record, judge the blame verdict, and
+        land the trace in the store + run log + SLO ledger."""
+        if trace is None:
+            self._observe_budgets("predict", wall_ms, "untraced")
+            return
+        d = req.dispatch or {}
+        t0_ts = d.get("t0_ts", req.enqueued_ts)
+        trace.add_span("queue_wait", req.enqueued_ts, req.queue_ms,
+                   component="queue_wait", depth=d.get("co_requests"))
+        _rt.stamp_dispatch_spans(
+            trace, t0_ts, float(d.get("infer_ms", 0.0)), d, "infer",
+            default_bucket=req.rows, rows=req.rows,
+            co_requests=d.get("co_requests"),
+            device_ms=d.get("device_ms"))
+        trace.finish("ok")
+        if respond_ms:
+            trace.add_span("respond", trace.finished_at - respond_ms / 1000.0,
+                       respond_ms, component="respond")
+        self._close_books(trace)
+        self._land(trace, "predict")
+
+    def _finish_generate_trace(self, req) -> None:
+        """GenerationBatcher retire hook: close out one generation's
+        trace (components were tallied live by the worker), compute the
+        co-batch-stall split, and land it."""
+        trace = getattr(req, "trace", None)
+        if trace is None:
+            # enqueue-to-retire, NOT stats()["dur_s"]: dur_s is 0.0
+            # for a request that never emitted a token (504 timeout,
+            # prefill failure) and only partial for a timed-out one —
+            # a budget must burn on the wall the client actually
+            # waited, with recording off exactly like on
+            wall_ms = (time.perf_counter() - req.enqueued_at) * 1000.0
+            self._observe_budgets("generate", wall_ms, "untraced",
+                                  ttft_ms=req.ttft_ms())
+            return
+        if trace.attrs.pop("timed_out", None):
+            # the handler already told the client 504: land a terminal
+            # dispatch_timeout REJECTION (per-reason counted) whose
+            # full wall still burns the budgets — but keep its
+            # components OUT of the healthy baseline, a 30s timeout
+            # must not drag the medians the blame verdict judges by
+            ttft = req.ttft_ms()
+            if ttft is not None:
+                trace.attrs["ttft_ms"] = round(ttft, 3)
+            trace.attrs["n_tokens"] = len(req.tokens)
+            trace.finish("rejected", "dispatch_timeout")
+            self._close_books(trace)
+            self._land(trace, "generate", ttft_ms=ttft,
+                       observe_baseline=False)
+            return
+        status = {"error": "error",
+                  "cancelled": "cancelled"}.get(req.finish_reason, "ok")
+        baseline = self._baselines["generate"]
+        # co_batch_stall: decode iterations that rode a LARGER co-batch
+        # than the endpoint's typical one, judged against the typical
+        # per-iteration cost — the time this request lost to riding a
+        # crowded batch, split out of decode compute
+        base_iter = baseline.median("decode_iter_ms")
+        base_cb = baseline.median("decode_co_batch") or 1.0
+        stall = 0.0
+        for ms, cb in trace.iters:
+            baseline.observe("decode_iter_ms", ms)
+            baseline.observe("decode_co_batch", cb)
+            if baseline.samples >= _rt.BASELINE_MIN_SAMPLES \
+                    and cb > base_cb and base_iter:
+                stall += max(0.0, ms - base_iter)
+        if stall > 0:
+            trace.add_component("co_batch_stall", stall)
+            trace.add_component("compute", -stall)
+        ttft = req.ttft_ms()
+        if ttft is not None:
+            trace.attrs["ttft_ms"] = round(ttft, 3)
+        trace.attrs["n_tokens"] = len(req.tokens)
+        trace.attrs["finish_reason"] = req.finish_reason
+        trace.finish(status, req.error if status == "error" else None)
+        self._close_books(trace)
+        self._land(trace, "generate", ttft_ms=ttft)
+
+    @staticmethod
+    def _close_books(trace: "_rt.RequestTrace") -> None:
+        """Every millisecond of wall time must be owned by exactly one
+        span: whatever the instrumented crossings did not claim (host
+        scheduling, sampling, queue hand-offs) becomes one explicit
+        ``host`` residual span instead of a silent gap — the component
+        sum equals the observed wall time by construction.  The
+        residual is judged against the COMPONENT tally, not the span
+        list: spans dropped past the per-trace cap already tallied
+        their milliseconds there, and must not be counted again."""
+        rem = (trace.total_ms or 0.0) - sum(trace.components.values())
+        if rem > 0.05:
+            trace.add_span("host", trace.finished_at - rem / 1000.0, rem,
+                       component="host")
+
+    def _land(self, trace: "_rt.RequestTrace", endpoint: str,
+              ttft_ms: Optional[float] = None,
+              observe_baseline: bool = True) -> None:
+        """The one landing sequence: blame + baseline (healthy
+        completions only — ``observe_baseline=False`` for rejected
+        walls that must not drag the medians), budget observation,
+        store, run-log emission."""
+        if observe_baseline:
+            baseline = self._baselines[endpoint]
+            trace.blame = _rt.blame_verdict(trace.components, baseline,
+                                            trace.total_ms)
+            baseline.observe_components(trace.components)
+        violated = self._observe_budgets(endpoint,
+                                         trace.total_ms or 0.0,
+                                         trace.trace_id,
+                                         ttft_ms=ttft_ms)
+        if violated:
+            trace.attrs["slo_violated"] = violated
+        self.traces.add(trace)
+        self._emit_request(trace, violated=violated)
 
     # -- views -------------------------------------------------------------
     def status(self) -> Dict[str, Any]:
@@ -277,6 +525,13 @@ class ModelServer:
             gen["decode_buckets"] = list(self.executor.decode_buckets)
             gen["cache_buckets"] = list(self.executor.cache_buckets)
             st["generate"] = gen
+        if self.traces is not None:
+            # the tail-aware trace summary: counts, slowest-k ids per
+            # endpoint (the p99 exemplars), rejection reasons — the
+            # evidence index tpu_watch and humans-with-curl start from
+            st["traces"] = self.traces.summary()
+        if self.slo.active():
+            st["slo"] = self.slo.status()
         try:
             # resident-executable HBM (weights + code + largest bucket
             # scratch): the number ROADMAP item 2's KV-cache budget
@@ -318,6 +573,40 @@ class ModelServer:
                 else f"bigdl_gen_{key}"
             lines.append(f"# TYPE {name} {mtype}")
             lines.append(f'{name}{{model="{self.name}"}} {float(v):g}')
+        # real OpenMetrics histograms (fixed log-spaced buckets) beside
+        # the ring-buffer gauges above: external scrapers compute
+        # arbitrary quantiles from these; the gauges stay for
+        # tpu_watch.sh (docs/observability.md)
+        label = f'model="{self.name}"'
+        lines.extend(self._hist["predict"].openmetrics(
+            "bigdl_serve_latency_ms", f'{label},endpoint="predict"'))
+        if self.gen_batcher is not None:
+            lines.extend(self._hist["generate"].openmetrics(
+                "bigdl_serve_latency_ms",
+                f'{label},endpoint="generate"', type_line=False))
+            lines.extend(self._hist["ttft"].openmetrics(
+                "bigdl_serve_ttft_ms", label))
+        if self.traces is not None:
+            rej = self.traces.summary()["rejections"]
+            if rej:
+                lines.append("# TYPE bigdl_serve_rejected_by_reason"
+                             "_total counter")
+                for reason, n in sorted(rej.items()):
+                    lines.append(
+                        f"bigdl_serve_rejected_by_reason_total"
+                        f'{{{label},reason="{reason}"}} {n}')
+        if self.slo.active():
+            burn = self.slo.burn()
+            for which, metric in (("p99", "bigdl_slo_p99_burn_ratio"),
+                                  ("ttft", "bigdl_slo_ttft_burn_ratio")):
+                b = (burn.get(which) or {}).get("burn")
+                if b is None:
+                    continue
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(f"{metric}{{{label}}} {float(b):g}")
+            lines.append("# TYPE bigdl_slo_violations_total counter")
+            lines.append(f"bigdl_slo_violations_total{{{label}}} "
+                         f"{self.slo.violations}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
@@ -392,32 +681,65 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(404)
                 return
             srv = self._server()
-            if srv.draining():
-                self._json(503, {"error": "draining"})
-                return
             t0 = time.perf_counter()
+            t0_ts = time.time()
+            # accept/propagate a client X-Request-Id, mint otherwise —
+            # echoed on EVERY response (success or rejection), so a
+            # user ticket names the trace the operator pulls
+            trace_id, trace = srv.start_trace(
+                "predict", self.headers.get("X-Request-Id"))
+            rid = {"X-Request-Id": trace_id}
+            if srv.draining():
+                srv.finish_rejected(trace, "draining")
+                self._json(503, {"error": "draining"}, headers=rid)
+                return
             try:
                 length = int(self.headers.get("Content-Length") or 0)
                 payload = json.loads(self.rfile.read(length) or b"{}")
                 arr, single = srv.parse_inputs(payload)
             except (ValueError, TypeError) as e:
-                self._json(400, {"error": str(e)})
+                self._json(400, {"error": str(e)}, headers=rid)
                 return
+            if trace is not None:
+                trace.add_span("parse", t0_ts,
+                           (time.perf_counter() - t0) * 1000.0,
+                           component="host")
             try:
-                out, queue_ms = srv.predict(arr)
+                req = srv.predict(arr, trace=trace)
             except QueueFullError as e:
-                self._json(429, {"error": str(e)})
+                reason = "draining" if srv.draining() else "queue_full"
+                srv.finish_rejected(trace, reason)
+                self._json(503 if reason == "draining" else 429,
+                           {"error": str(e)}, headers=rid)
                 return
             except TimeoutError as e:
-                self._json(504, {"error": str(e)})
+                srv.finish_rejected(
+                    trace, "dispatch_timeout", trace_id=trace_id,
+                    wall_ms=(time.perf_counter() - t0) * 1000.0)
+                self._json(504, {"error": str(e)}, headers=rid)
                 return
-            outs = np.asarray(out)
+            except Exception as e:  # noqa: BLE001 - worker-relayed
+                # a dispatch failure (req.error) still honours the id
+                # contract: echo the header, land a terminal trace
+                srv.finish_failed(
+                    trace, f"{type(e).__name__}: {e}",
+                    trace_id=trace_id,
+                    wall_ms=(time.perf_counter() - t0) * 1000.0)
+                self._json(500, {"error": f"{type(e).__name__}: {e}"},
+                           headers=rid)
+                return
+            t_resp0 = time.perf_counter()
+            outs = np.asarray(req.output)
             if single:
                 outs = outs[0]  # one sample in -> one sample out
-            self._json(200, {
-                "outputs": outs.tolist(),
-                "ms": round((time.perf_counter() - t0) * 1000.0, 3),
-                "queue_ms": round(queue_ms, 3)})
+            body = {"outputs": outs.tolist(),
+                    "ms": round((time.perf_counter() - t0) * 1000.0, 3),
+                    "queue_ms": round(req.queue_ms, 3),
+                    "trace_id": trace_id}
+            srv._finish_predict_trace(
+                trace, req, (time.perf_counter() - t_resp0) * 1000.0,
+                wall_ms=body["ms"])
+            self._json(200, body, headers=rid)
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001 - the server must survive
@@ -435,38 +757,61 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": "server not started with "
                                       "--generate"})
             return
+        t0 = time.perf_counter()
+        t0_ts = time.time()
+        trace_id, trace = srv.start_trace(
+            "generate", self.headers.get("X-Request-Id"))
+        rid = {"X-Request-Id": trace_id}
         if srv.draining():
-            self._json(503, {"error": "draining"})
+            srv.finish_rejected(trace, "draining", endpoint="generate")
+            self._json(503, {"error": "draining"}, headers=rid)
             return
         try:
             length = int(self.headers.get("Content-Length") or 0)
             payload = json.loads(self.rfile.read(length) or b"{}")
             kwargs, stream = srv.parse_generate(payload)
         except (ValueError, TypeError) as e:
-            self._json(400, {"error": str(e)})
+            self._json(400, {"error": str(e)}, headers=rid)
             return
+        if trace is not None:
+            trace.add_span("parse", t0_ts,
+                       (time.perf_counter() - t0) * 1000.0,
+                       component="host")
         try:
-            req = srv.gen_batcher.submit(**kwargs)
+            req = srv.gen_batcher.submit(trace=trace, **kwargs)
         except QueueFullError as e:
-            self._json(429, {"error": str(e)})
+            reason = "draining" if srv.draining() else "queue_full"
+            srv.finish_rejected(trace, reason, endpoint="generate")
+            self._json(503 if reason == "draining" else 429,
+                       {"error": str(e)}, headers=rid)
             return
         except ValueError as e:  # prompt vs cache-bucket bounds
-            self._json(400, {"error": str(e)})
+            self._json(400, {"error": str(e)}, headers=rid)
             return
         if not stream:
             if not req.wait(srv.request_timeout_s):
+                # stamp BEFORE cancel: the retire hook reads it and
+                # lands the trace as a dispatch_timeout REJECTION (the
+                # per-reason counters must see generate 504s exactly
+                # like predict ones), not a generic cancellation
+                if trace is not None:
+                    trace.attrs["timed_out"] = True
                 req.cancel()
                 self._json(504, {"error": "no completion within "
-                                          f"{srv.request_timeout_s}s"})
+                                          f"{srv.request_timeout_s}s"},
+                           headers=rid)
                 return
             if req.error is not None:
-                self._json(500, {"error": req.error})
+                self._json(500, {"error": req.error}, headers=rid)
                 return
-            self._json(200, {"tokens": req.tokens, **req.stats()})
+            self._json(200, {"tokens": req.tokens,
+                             "trace_id": trace_id, **req.stats()},
+                       headers=rid)
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonl")
         self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Request-Id", trace_id)
         self.end_headers()
         with srv._streams_lock:
             srv._open_streams += 1
@@ -478,14 +823,22 @@ class _Handler(BaseHTTPRequestHandler):
                     i += 1
                 elif ev[0] == "done":
                     self._chunk({"done": True, "tokens": req.tokens,
-                                 **ev[1]})
+                                 "trace_id": trace_id, **ev[1]})
                 else:  # error sentinel
                     self._chunk({"error": ev[1]})
             self.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError, TimeoutError):
-            # client gone or stalled: free the decode slot instead of
-            # generating for nobody; the chunked body was never
-            # terminated, so the connection cannot be reused
+        except TimeoutError:
+            # the decode stream stalled server-side past the request
+            # timeout — a dispatch_timeout like the predict 504, and
+            # recorded as one via the stamp
+            if trace is not None:
+                trace.attrs["timed_out"] = True
+            req.cancel()
+            self.close_connection = True
+        except (BrokenPipeError, ConnectionResetError):
+            # client gone: free the decode slot instead of generating
+            # for nobody; the chunked body was never terminated, so
+            # the connection cannot be reused
             req.cancel()
             self.close_connection = True
         finally:
@@ -503,7 +856,7 @@ class _Handler(BaseHTTPRequestHandler):
             srv = self._server()
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if path in ("/", "/status"):
-                status: Dict[str, Any] = {"serving": srv.status()}
+                status: Dict[str, Any] = {}
                 try:
                     from bigdl_tpu.telemetry.metrics_http import \
                         _observer_status
@@ -511,6 +864,12 @@ class _Handler(BaseHTTPRequestHandler):
                     status.update(_observer_status())
                 except Exception:  # noqa: BLE001 - observers best-effort
                     pass
+                # THIS frontend's own serving block, set LAST: the
+                # observer block reads the process-global serving.get()
+                # — with several live servers in one process it names
+                # whichever registered last, and each port must report
+                # itself
+                status["serving"] = srv.status()
                 self._json(200, status)
             elif path == "/metrics":
                 body = srv.openmetrics().encode("utf-8")
@@ -521,6 +880,26 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(503, {"ok": False, "draining": True})
                 else:
                     self._json(200, {"ok": True})
+            elif path.startswith("/v1/trace/"):
+                # the evidence endpoint: "request abc123 was slow" ->
+                # curl the id off the user's X-Request-Id echo and read
+                # the waterfall + blame verdict
+                tid = path[len("/v1/trace/"):]
+                if srv.traces is None:
+                    self._json(404, {"error": "tracing disabled "
+                                              "(BIGDL_TRACE=off)"})
+                elif not tid:
+                    self._json(400, {"error": "GET /v1/trace/<id>"})
+                else:
+                    doc = srv.traces.get(tid)
+                    if doc is None:
+                        self._json(404, {
+                            "error": f"trace {tid!r} not retained "
+                                     f"(ring {srv.traces.ring} + "
+                                     f"slowest-{srv.traces.slowest_k} "
+                                     f"per endpoint)"})
+                    else:
+                        self._json(200, doc)
             else:
                 self.send_error(404)
         except Exception:  # noqa: BLE001 - the server must survive
@@ -529,14 +908,19 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001
                 pass
 
-    def _json(self, code: int, obj: Dict[str, Any]) -> None:
+    def _json(self, code: int, obj: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
         self._respond(code, (json.dumps(obj, default=str) + "\n"
-                             ).encode("utf-8"), "application/json")
+                             ).encode("utf-8"), "application/json",
+                      headers=headers)
 
-    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+    def _respond(self, code: int, body: bytes, ctype: str,
+                 headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 

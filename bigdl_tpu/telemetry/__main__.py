@@ -13,6 +13,8 @@ Options::
     python -m bigdl_tpu.telemetry p0.jsonl p1.jsonl ...      # fleet view
     python -m bigdl_tpu.telemetry p0.jsonl p1.jsonl --chrome fleet.json
     python -m bigdl_tpu.telemetry fleet <dir> [--watch]      # live fleet table
+    python -m bigdl_tpu.telemetry trace run.jsonl --slowest 3  # request
+    python -m bigdl_tpu.telemetry trace run.jsonl --id abc123  # waterfalls
     python -m bigdl_tpu.telemetry diff old.jsonl new.jsonl   # regression
     python -m bigdl_tpu.telemetry diff old_bench.json new_bench.json
     python -m bigdl_tpu.telemetry attribute --model lenet    # per-module cost
@@ -38,6 +40,10 @@ from a run log's ``attribution`` event; ``--comms`` switches to the
 per-collective view (bytes moved, mesh axes, owning modules, bandwidth
 vs ``BIGDL_PEAK_BW``), enriched with measured per-collective wall time
 when the log names a perfetto profiler capture that still exists.
+``trace`` renders per-request serving waterfalls offline from a run
+log's ``request`` events (telemetry/request_trace.py) — the slowest N
+by default, one exact id with ``--id``, request-lane Chrome output with
+``--chrome``.
 """
 
 from __future__ import annotations
@@ -263,12 +269,17 @@ def main(argv=None) -> int:
         from bigdl_tpu.telemetry import fleet as fleet_mod
 
         return fleet_mod.main(argv[1:])
+    if argv and argv[0] == "trace":
+        from bigdl_tpu.telemetry import request_trace
+
+        return request_trace.trace_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="bigdl_tpu.telemetry",
         description="summarize / compare / export telemetry run logs "
                     "(subcommands: diff <runA> <runB>, fleet <dir> "
-                    "[--watch], attribute [run.jsonl | --model NAME] "
+                    "[--watch], trace run.jsonl [--slowest N|--id ID], "
+                    "attribute [run.jsonl | --model NAME] "
                     "[--comms|--memory], memory --model NAME --mesh N)")
     p.add_argument("runs", nargs="+", metavar="run.jsonl",
                    help="path(s) to run-*.jsonl event logs; several "

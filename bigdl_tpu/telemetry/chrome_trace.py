@@ -12,11 +12,17 @@ Mapping (one lane per pid/tid, as the tracer emitted them):
   records / throughput in args;
 - ``compile`` -> complete events on their thread;
 - ``counter``/``gauge`` -> counter tracks (``ph: C``);
-- ``event``/``retrace`` -> instant events (``ph: i``).
+- ``event``/``retrace`` -> instant events (``ph: i``);
+- ``request`` (serving request traces, telemetry/request_trace.py) ->
+  one NAMED LANE per request (synthetic tid from the trace id, labelled
+  ``req <id> [endpoint]``) holding the span waterfall as complete
+  events plus per-token instants — the per-request timeline view of a
+  serving run.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 from typing import Any, Dict, Iterable, List
 
@@ -33,6 +39,33 @@ def _args(event: Dict[str, Any]) -> Dict[str, Any]:
 
 def _us(ts: float) -> float:
     return ts * 1e6
+
+
+def _request_lane(ev: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One serving request trace -> a named lane of span waterfalls.
+    The tid is a stable hash of the trace id (each request gets its own
+    lane; re-exports are deterministic)."""
+    trace_id = str(ev.get("trace_id", "?"))
+    pid = ev.get("pid", 0)
+    tid = int(hashlib.sha1(trace_id.encode()).hexdigest()[:8], 16)
+    label = f"req {trace_id} [{ev.get('endpoint', '?')}]"
+    out: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+         "ts": 0, "args": {"name": label}}]
+    for span in ev.get("spans") or []:
+        args = {k: v for k, v in span.items()
+                if k not in ("name", "t0", "ms")}
+        args["trace_id"] = trace_id
+        out.append({"ph": "X", "name": span.get("name", "?"),
+                    "cat": "request", "pid": pid, "tid": tid,
+                    "ts": _us(float(span.get("t0", 0.0))),
+                    "dur": _us(float(span.get("ms", 0.0)) / 1000.0),
+                    "args": args})
+    for i, tok_ts in enumerate(ev.get("token_ts") or []):
+        out.append({"ph": "i", "name": f"token {i}", "cat": "request",
+                    "pid": pid, "tid": tid, "ts": _us(float(tok_ts)),
+                    "s": "t", "args": {"trace_id": trace_id}})
+    return out
 
 
 def chrome_trace(events: Iterable[Dict[str, Any]],
@@ -77,6 +110,8 @@ def chrome_trace(events: Iterable[Dict[str, Any]],
             out.append({"ph": "C", "name": name, "pid": pid, "tid": tid,
                         "ts": _us(ts),
                         "args": {name: ev.get("value", 0.0)}})
+        elif kind == "request":
+            out.extend(_request_lane(ev))
         elif kind in ("event", "retrace"):
             name = ev.get("name") or ev.get("rule", "?")
             args = _args(ev)

@@ -103,6 +103,16 @@ _RULES: List[Tuple[str, str, str]] = [
     (".ttft_p50_ms", "lower", "pct"),
     (".ttft_p99_ms", "lower", "pct"),
     (".itl_p99_ms", "lower", "pct"),
+    # request-level tracing (telemetry/request_trace.py): end-to-end
+    # per-request latency from `request` events (ingress to done —
+    # includes queue, padding, respond; the batch-level serve_p99_ms
+    # above sees only queue+infer), and SLO violations as a zero-slack
+    # count — a candidate that starts blowing a declared budget fails
+    # even when the percentile drift stays under the pct threshold
+    ("request_p50_ms", "lower", "pct"),
+    ("request_p99_ms", "lower", "pct"),
+    ("slo_violations", "lower", "count"),
+    (".slo_violations", "lower", "count"),
 ]
 
 
@@ -187,6 +197,28 @@ def run_log_metrics(path: str) -> Dict[str, Any]:
         span = max(e["ts"] for e in serves) - min(e["ts"] for e in serves)
         if span > 0:
             out["serve_qps"] = rows / span
+    # request traces (telemetry/request_trace.py, kind "request"): the
+    # TRUE end-to-end per-request percentiles (the serve fold above is
+    # per batch and sees only queue+infer), plus the SLO violation count
+    reqs = [e for e in events if e.get("kind") == "request"]
+    if reqs:
+        from bigdl_tpu.telemetry.report import _percentile
+
+        # latency percentiles: completed requests PLUS dispatch
+        # timeouts — a 504's wall is real waiting the client did and
+        # the live histograms include it; instant 429/503 rejections
+        # stay out (their ~0ms walls would dilute the percentiles)
+        timed = [e for e in reqs if e.get("status") != "rejected"
+                 or e.get("reason") == "dispatch_timeout"]
+        if timed:
+            lats = [float(e.get("ms", 0.0) or 0.0) for e in timed]
+            out["request_p50_ms"] = _percentile(lats, 50.0)
+            out["request_p99_ms"] = _percentile(lats, 99.0)
+        # violations count over EVERY event: a rejected-504 that blew
+        # the budget is precisely the violation the zero-slack gate
+        # must see (the RequestFold counts it the same way)
+        out["slo_violations"] = sum(1 for e in reqs
+                                    if e.get("slo_violated"))
     return out
 
 
@@ -215,7 +247,7 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
         for key in ("p50_ms", "p99_ms", "qps", "rejected",
                     "steady_compiles", "retrace_diagnostics",
                     "tokens_s", "ttft_p50_ms", "ttft_p99_ms",
-                    "itl_p99_ms"):
+                    "itl_p99_ms", "slo_violations"):
             if row.get(key) is not None:
                 out[f"{name}.{key}"] = float(row[key])
         # comms snapshot on bench rows (bench.py reads it off the scan

@@ -49,6 +49,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from bigdl_tpu.telemetry import request_trace
+
 __all__ = ["HostState", "FleetWatcher", "fleet_view", "blame",
            "fleet_width", "apply_topology",
            "format_fleet_view", "fleet_openmetrics", "main",
@@ -114,6 +116,15 @@ class HostState:
         self.gen_ttft_ms = 0.0
         self.gen_itl_p99_ms = 0.0
         self._gen_window: deque = deque(maxlen=WINDOW_STEPS)
+        # SLO burn accounting (telemetry/request_trace.py SLOTracker):
+        # the serving replica's latest windowed-p99 / declared-budget
+        # gauges plus its violation count and slowest traced request —
+        # the fleet's "which replica is burning its budget" columns
+        self.slo_p99_burn: Optional[float] = None
+        self.slo_ttft_burn: Optional[float] = None
+        # shared request_trace.RequestFold — one fold implementation
+        # with the MetricsSink, so the two live views can't diverge
+        self.requests = request_trace.RequestFold()
         # (step, ts, dur, components) rows, newest last
         self.window: deque = deque(maxlen=WINDOW_STEPS)
         self._pending: Dict[str, float] = {}
@@ -161,6 +172,15 @@ class HostState:
             elif kind == "health":
                 if ev.get("nonfinite_grads") or ev.get("nonfinite_params"):
                     self.nonfinite_steps += 1
+            elif kind == "gauge":
+                name = ev.get("name")
+                if name == "serve/slo_p99_burn":
+                    self.slo_p99_burn = float(ev.get("value", 0.0) or 0.0)
+                elif name == "serve/slo_ttft_burn":
+                    self.slo_ttft_burn = float(ev.get("value", 0.0)
+                                               or 0.0)
+            elif kind == "request":
+                self.requests.fold(ev)
             elif kind == "generate":
                 toks = int(ev.get("tokens", 0) or 0)
                 self.gen_tokens += toks
@@ -282,6 +302,11 @@ class HostState:
                 "gen_tokens_s": self.gen_tokens_s(now),
                 "gen_ttft_ms": self.gen_ttft_ms,
                 "gen_itl_p99_ms": self.gen_itl_p99_ms,
+                "slo_p99_burn": self.slo_p99_burn,
+                "slo_ttft_burn": self.slo_ttft_burn,
+                "slo_violations": self.requests.slo_violations,
+                "request_count": self.requests.count,
+                "slowest_request": dict(self.requests.slowest),
                 "checkpoint_step": self.ckpt_step,
                 "checkpoint_age_s": (round(now - self.ckpt_ts, 3)
                                      if self.ckpt_ts else None),
@@ -535,6 +560,23 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
             # steps — show the rate and tail next to the step columns
             hbm += (f"gen {r.get('gen_tokens_s', 0.0)}tok/s "
                     f"ttft {r.get('gen_ttft_ms', 0.0):.0f}ms  ")
+        if r.get("slo_p99_burn") is not None \
+                or r.get("slo_ttft_burn") is not None:
+            # serving replica with declared budgets: burn = windowed
+            # p99 / budget, 1.0x means the budget is exactly spent
+            cells = []
+            if r.get("slo_p99_burn") is not None:
+                cells.append(f"p99 {r['slo_p99_burn']:.2f}x")
+            if r.get("slo_ttft_burn") is not None:
+                cells.append(f"ttft {r['slo_ttft_burn']:.2f}x")
+            hbm += f"slo {'/'.join(cells)}"
+            if r.get("slo_violations"):
+                hbm += f" viol {r['slo_violations']}"
+            slow = r.get("slowest_request") or {}
+            if slow.get("trace_id"):
+                hbm += (f" slowest {slow['trace_id']}"
+                        f"@{slow.get('ms', 0.0):.0f}ms")
+            hbm += "  "
         lines.append(
             f"p{p['process_index']:<3} step {p['last_step']:<6} "
             f"age {age if age is not None else '?':>7}s  "
@@ -781,7 +823,14 @@ def fleet_openmetrics() -> List[str]:
                  "latest generation TTFT per decode replica"),
                 ("bigdl_fleet_gen_itl_p99_ms", "gen_itl_p99_ms",
                  "latest generation p99 inter-token latency per "
-                 "decode replica")]
+                 "decode replica"),
+                ("bigdl_fleet_slo_p99_burn", "slo_p99_burn",
+                 "serving p99 SLO burn rate per replica (observed "
+                 "windowed p99 / declared budget)"),
+                ("bigdl_fleet_slo_ttft_burn", "slo_ttft_burn",
+                 "TTFT SLO burn rate per replica"),
+                ("bigdl_fleet_slo_violations_total", "slo_violations",
+                 "requests over a declared SLO budget per replica")]
     for metric, field, help_ in per_host:
         lines.append(f"# HELP {metric} {help_}")
         lines.append(f"# TYPE {metric} gauge")

@@ -47,6 +47,13 @@ __all__ = ["MetricsSink", "MetricsServer", "start_server"]
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _request_fold():
+    # lazy like the memory/fleet imports below: request_trace pulls in
+    # the telemetry package, which imports this module
+    from bigdl_tpu.telemetry.request_trace import RequestFold
+    return RequestFold()
+
+
 def _metric_name(name: str, prefix: str = "bigdl_") -> str:
     """Telemetry stream name -> legal Prometheus metric name."""
     return prefix + _NAME_RE.sub("_", str(name)).strip("_")
@@ -87,6 +94,11 @@ class MetricsSink:
         self.gen_requests = 0
         self.gen_tokens = 0
         self.last_gen: Dict[str, Any] = {}
+        # serving request traces (kind "request"): the shared
+        # request_trace.RequestFold — one fold implementation with the
+        # FleetWatcher's per-host state, the run-log twin of the
+        # server's own /status.traces summary
+        self.requests = _request_fold()
         # per-collective comms attribution (kind "comms",
         # telemetry/comms.py): the latest per-step snapshot
         self.last_comms: Dict[str, Any] = {}
@@ -158,6 +170,8 @@ class MetricsSink:
                 self.last_gen = {k: event[k] for k in
                                  ("tokens", "ttft_ms", "itl_p99_ms",
                                   "finish", "dur") if k in event}
+            elif kind == "request":
+                self.requests.fold(event)
             elif kind == "comms":
                 self.last_comms = {k: event[k] for k in
                                    ("count", "bytes", "payload_bytes",
@@ -215,6 +229,12 @@ class MetricsSink:
                     "gen_requests": self.gen_requests,
                     "gen_tokens": self.gen_tokens,
                     "last_gen": dict(self.last_gen),
+                    "requests": {
+                        "count": self.requests.count,
+                        "by_endpoint": dict(self.requests.by_endpoint),
+                        "rejections": dict(self.requests.rejections),
+                        "slo_violations": self.requests.slo_violations,
+                        "slowest": dict(self.requests.slowest)},
                     "comms": dict(self.last_comms),
                     "memory": dict(self.last_memory)}
 
@@ -289,6 +309,18 @@ class MetricsSink:
                        self.last_gen.get("itl_p99_ms"),
                        "latest completed generation's p99 inter-token "
                        "latency")
+            if self.requests.count:
+                sample("bigdl_request_traces_total", "counter",
+                       self.requests.count,
+                       "serving request traces observed")
+                sample("bigdl_request_slo_violations_total", "counter",
+                       self.requests.slo_violations,
+                       "requests over a declared SLO budget")
+                sample("bigdl_request_slowest_ms", "gauge",
+                       self.requests.slowest.get("ms"),
+                       "slowest completed request seen "
+                       f"(trace_id="
+                       f"{self.requests.slowest.get('trace_id', '?')})")
             sample("bigdl_compiles_total", "counter", self.compiles,
                    "XLA compiles observed")
             sample("bigdl_compile_seconds_total", "counter",

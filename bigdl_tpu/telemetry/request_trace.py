@@ -1,0 +1,775 @@
+"""Request-level tracing for the serving path (docs/observability.md
+"Tracing a request").
+
+The training side of the observability stack answers "which host /
+module / collective is slow" (fleet skew blame, attribution, comms);
+serving until now answered only in aggregate — qps and p50/p99 per
+batch.  When ONE user's request is slow there was no record of *which*
+request, *where* the time went, or *why*.  This module is the serving
+analogue of the fleet step-skew blame, applied per request:
+
+- every request admitted by :class:`~bigdl_tpu.serving.ModelServer`
+  carries a **trace id** (an ``X-Request-Id`` header is accepted and
+  propagated; otherwise one is minted) which is echoed on the response,
+  so a user's "request abc123 was slow" ticket names its own evidence;
+- a :class:`RequestTrace` records the **span timeline** at the points
+  the request actually crosses: ingress/parse, queue wait, bucket
+  selection + padding, executor dispatch, device compute — and for
+  ``/v1/generate``: prefill, every decode iteration the request rode
+  (with that iteration's co-batch size) and per-token emit stamps — so
+  TTFT and inter-token time decompose into attributable parts;
+- traces land in a bounded :class:`TraceStore` with **tail-aware
+  retention**: a ring of recent traces PLUS the slowest-k per endpoint
+  are always kept, so the p99 exemplar is never evicted by the healthy
+  requests that followed it.  Surfaced as ``GET /v1/trace/<id>`` and a
+  ``/status.traces`` summary, exported as request-lane Chrome/Perfetto
+  waterfalls (``chrome_trace.py`` renders ``request`` events), and
+  rendered offline by ``python -m bigdl_tpu.telemetry trace run.jsonl
+  [--slowest N]``;
+- a **slow-request blame verdict** — the fleet-blame pattern applied
+  per request: each trace's attributable components (queue_wait,
+  prefill_interference, co_batch_stall, padding, compile) are judged
+  against the endpoint's rolling :class:`ComponentBaseline`; compute is
+  blamed only when nothing attributable explains the excess — on a
+  coalesced batch every co-batched request's wall time degrades
+  together, so compute excess alone cannot localize a culprit;
+- **SLO burn accounting** — declared budgets (``--slo-p99-ms``,
+  ``--slo-ttft-ms``) become live burn-rate gauges
+  (observed windowed p99 / budget) on ``/metrics``, fleet columns in
+  the FleetWatcher, and a ``bench_serving.py --slo-*`` exit-4 gate,
+  with every SLO-violating request carrying its trace id.
+
+Knobs (``utils/config.py``): ``BIGDL_TRACE`` (default on),
+``BIGDL_TRACE_RING`` (recent ring size), ``BIGDL_TRACE_SLOWEST``
+(always-kept slowest-k per endpoint), ``BIGDL_TRACE_SPANS`` (per-trace
+span cap — decode iterations past the cap are tallied, not recorded).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from bigdl_tpu import telemetry as _telemetry
+from bigdl_tpu.telemetry.report import _percentile
+
+__all__ = ["RequestTrace", "TraceStore", "ComponentBaseline",
+           "SLOTracker", "LatencyHistogram", "RequestFold",
+           "blame_verdict", "mint_id",
+           "valid_id", "stamp_dispatch_spans", "format_trace",
+           "request_events",
+           "summarize_requests", "trace_main", "LATENCY_BUCKETS_MS",
+           "ATTRIBUTABLE", "BLAME_MIN_EXCESS_MS", "BLAME_REL_EXCESS",
+           "BASELINE_MIN_SAMPLES", "VIOLATING_KEEP"]
+
+#: fixed log-spaced OpenMetrics histogram bucket bounds (milliseconds):
+#: external scrapers compute arbitrary quantiles from these, so the
+#: bounds must be STABLE across releases — never derived from traffic
+LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0)
+
+#: blame components judged BEFORE compute, in this order at ties.  The
+#: fleet-blame discipline (telemetry/fleet.py): attributable components
+#: first, the residual (compute) only when nothing else explains the
+#: excess — on a coalesced batch, a straggling co-batch inflates every
+#: rider's wall time equally, so compute excess alone cannot localize.
+ATTRIBUTABLE: Tuple[str, ...] = (
+    "queue_wait", "prefill_interference", "co_batch_stall", "padding",
+    "compile")
+
+#: a component excess must clear BOTH floors to be blamed: an absolute
+#: ms floor and a fraction of the endpoint's baseline total
+BLAME_MIN_EXCESS_MS = 5.0
+BLAME_REL_EXCESS = 0.2
+#: verdicts need a baseline: with fewer observed requests than this the
+#: endpoint is still warming up and every verdict would be noise
+BASELINE_MIN_SAMPLES = 8
+#: the SLO ledger keeps the trace ids of this many WORST violators (by
+#: budget-overshoot ratio) — bounded so a sustained burn cannot grow it
+#: without limit, worst-first so the evidence kept is the evidence that
+#: matters
+VIOLATING_KEEP = 32
+
+_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+
+def mint_id() -> str:
+    """A fresh trace id (16 hex chars — short enough for a log line,
+    collision-safe for a single server's retention window)."""
+    return uuid.uuid4().hex[:16]
+
+
+def valid_id(trace_id: Optional[str]) -> bool:
+    """Whether a client-supplied ``X-Request-Id`` is safe to propagate
+    (bounded length, header/log-safe charset) — anything else is
+    replaced by a minted id rather than rejected."""
+    return bool(trace_id) and _ID_RE.match(trace_id) is not None
+
+
+class RequestTrace:
+    """One request's span timeline + component tally.
+
+    Spans are ``{"name", "t0" (epoch seconds), "ms", ...attrs}`` dicts
+    appended in completion order; ``max_spans`` bounds the list (a
+    2048-token generation must not hold 2048 span dicts) — spans past
+    the cap still land in the COMPONENT tally, so accounting stays
+    complete even when the timeline is truncated (``spans_dropped``
+    says by how many).
+    """
+
+    __slots__ = ("trace_id", "endpoint", "started_at", "spans",
+                 "components", "attrs", "status", "reason", "total_ms",
+                 "finished_at", "max_spans", "spans_dropped", "iters",
+                 "blame", "token_ts")
+
+    def __init__(self, trace_id: str, endpoint: str,
+                 started_at: Optional[float] = None,
+                 max_spans: int = 512):
+        self.trace_id = trace_id
+        self.endpoint = endpoint
+        self.started_at = time.time() if started_at is None \
+            else started_at
+        self.spans: List[Dict[str, Any]] = []
+        self.components: Dict[str, float] = {}
+        self.attrs: Dict[str, Any] = {}
+        self.status: Optional[str] = None
+        self.reason: Optional[str] = None
+        self.total_ms: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.max_spans = max_spans
+        self.spans_dropped = 0
+        # (ms, co_batch) per decode iteration — the co_batch_stall
+        # input; bounded like spans
+        self.iters: List[Tuple[float, int]] = []
+        self.token_ts: List[float] = []
+        self.blame: Optional[Dict[str, Any]] = None
+
+    def add_span(self, name: str, t0: float, ms: float,
+             component: Optional[str] = None, **attrs) -> None:
+        """Record one span; ``component`` (default: ``name``) is the
+        blame bucket its milliseconds tally into (None string keeps it
+        out of the tally — purely decorative timeline entries)."""
+        if len(self.spans) < self.max_spans:
+            entry = {"name": name, "t0": round(t0, 6),
+                     "ms": round(ms, 3)}
+            entry.update(attrs)
+            self.spans.append(entry)
+        else:
+            self.spans_dropped += 1
+        key = name if component is None else component
+        if key:
+            self.components[key] = self.components.get(key, 0.0) + ms
+
+    def add_component(self, name: str, ms: float) -> None:
+        self.components[name] = self.components.get(name, 0.0) + ms
+
+    def note_iter(self, ms: float, co_batch: int) -> None:
+        if len(self.iters) < self.max_spans:
+            self.iters.append((ms, co_batch))
+
+    def note_token(self, ts: float) -> None:
+        if len(self.token_ts) < self.max_spans:
+            self.token_ts.append(round(ts, 6))
+
+    def finish(self, status: str = "ok", reason: Optional[str] = None,
+               now: Optional[float] = None) -> None:
+        self.finished_at = time.time() if now is None else now
+        self.status = status
+        self.reason = reason
+        self.total_ms = (self.finished_at - self.started_at) * 1000.0
+
+    def span_sum_ms(self) -> float:
+        return sum(s["ms"] for s in self.spans)
+
+    def to_dict(self) -> Dict[str, Any]:
+        # "t0", not "ts": these dicts travel verbatim as `request`
+        # event fields, and "ts" is the tracer's base emission stamp
+        out = {"trace_id": self.trace_id, "endpoint": self.endpoint,
+               "t0": round(self.started_at, 6),
+               "ms": round(self.total_ms or 0.0, 3),
+               "status": self.status or "open",
+               "spans": list(self.spans),
+               "components": {k: round(v, 3)
+                              for k, v in self.components.items()}}
+        if self.reason:
+            out["reason"] = self.reason
+        if self.spans_dropped:
+            out["spans_dropped"] = self.spans_dropped
+        if self.token_ts:
+            out["token_ts"] = list(self.token_ts)
+        if self.blame is not None:
+            out["blame"] = self.blame
+        out.update(self.attrs)
+        return out
+
+
+class ComponentBaseline:
+    """Rolling per-endpoint medians of named values — the "what does a
+    healthy request cost" reference the blame verdict judges against.
+    Medians (not means) so the slow tail being diagnosed does not drag
+    its own baseline after it."""
+
+    def __init__(self, window: int = 256):
+        self._window = window
+        self._vals: Dict[str, collections.deque] = {}
+        self._lock = threading.Lock()
+        self.samples = 0
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            dq = self._vals.get(name)
+            if dq is None:
+                dq = self._vals[name] = collections.deque(
+                    maxlen=self._window)
+            dq.append(float(value))
+
+    def observe_components(self, components: Dict[str, float]) -> None:
+        for name, value in components.items():
+            self.observe(name, value)
+        with self._lock:
+            self.samples += 1
+
+    def median(self, name: str) -> float:
+        with self._lock:
+            dq = self._vals.get(name)
+            if not dq:
+                return 0.0
+            vals = sorted(dq)
+        return vals[len(vals) // 2]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            names = list(self._vals)
+        return {n: round(self.median(n), 3) for n in names}
+
+
+def blame_verdict(components: Dict[str, float],
+                  baseline: ComponentBaseline,
+                  total_ms: Optional[float] = None
+                  ) -> Optional[Dict[str, Any]]:
+    """Name the component at fault for one request, judged against the
+    endpoint's rolling baseline.  Returns ``{cause, excess_ms, floor_ms,
+    baseline_ms}`` or None (healthy / baseline still warming up).
+
+    The floor mirrors the fleet skew blame: an excess must clear both an
+    absolute ms floor and a fraction of the baseline total — a 2 ms
+    queue blip on a 3 ms request is not a verdict."""
+    if baseline.samples < BASELINE_MIN_SAMPLES:
+        return None
+    base_total = sum(baseline.median(c)
+                     for c in ATTRIBUTABLE + ("compute",))
+    floor = max(BLAME_MIN_EXCESS_MS, BLAME_REL_EXCESS * base_total)
+    best: Optional[Tuple[str, float, float]] = None
+    for c in ATTRIBUTABLE:
+        got = float(components.get(c, 0.0))
+        base = baseline.median(c)
+        excess = got - base
+        if excess > floor and (best is None or excess > best[1]):
+            best = (c, excess, base)
+    if best is None:
+        got = float(components.get("compute", 0.0))
+        base = baseline.median("compute")
+        excess = got - base
+        if excess > floor:
+            best = ("compute", excess, base)
+    if best is None:
+        return None
+    return {"cause": best[0], "excess_ms": round(best[1], 3),
+            "floor_ms": round(floor, 3),
+            "baseline_ms": round(best[2], 3)}
+
+
+def stamp_dispatch_spans(trace: RequestTrace, t0_ts: float,
+                         wall_ms: float, rec: Dict[str, Any],
+                         name: str, default_bucket: int = 0,
+                         **attrs) -> None:
+    """Tile one coalesced dispatch's wall time onto a rider's trace as
+    the (compile, ``name``/compute, padding) split: an in-path compile
+    is its own blame component, the bucket rows nobody asked for own
+    their share of the remaining device time (padding waste), and the
+    rest is compute.  ``rec`` is the executor's dispatch record
+    (``compile_ms``/``bucket``/``padded_rows``).  Both the predict
+    batcher and the generate prefill stamp through here — the
+    attribution formula must not diverge between endpoints."""
+    compile_ms = float(rec.get("compile_ms", 0.0) or 0.0)
+    bucket = int(rec.get("bucket", default_bucket) or default_bucket)
+    padded = int(rec.get("padded_rows", 0) or 0)
+    pad_ms = (wall_ms - compile_ms) * padded / bucket if bucket else 0.0
+    comp_ms = max(0.0, wall_ms - compile_ms - pad_ms)
+    t = t0_ts
+    if compile_ms:
+        trace.add_span("compile", t, compile_ms, component="compile")
+        t += compile_ms / 1000.0
+    trace.add_span(name, t, comp_ms, component="compute",
+                   bucket=bucket, **attrs)
+    if pad_ms > 0:
+        trace.add_span("padding", t + comp_ms / 1000.0, pad_ms,
+                       component="padding", padded_rows=padded)
+
+
+class TraceStore:
+    """Bounded in-server trace retention: a ring of the ``ring`` most
+    recent traces PLUS the slowest-``slowest_k`` per endpoint, which are
+    never evicted by recency — the p99 exemplar survives the thousand
+    healthy requests that follow it.  Rejection reasons are counted here
+    too (the ``/metrics`` per-reason counters)."""
+
+    def __init__(self, ring: int = 512, slowest_k: int = 8):
+        self.ring = max(1, int(ring))
+        self.slowest_k = max(0, int(slowest_k))
+        self._lock = threading.Lock()
+        self._by_id: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
+        self._recent: collections.deque = collections.deque()
+        # set mirrors of the recency deque and the tail slots, so the
+        # per-request eviction checks are O(1) under the lock — this
+        # runs on the serving hot path
+        self._recent_ids: set = set()
+        self._pinned_ids: set = set()
+        # endpoint -> ascending [(ms, trace_id)] of the kept slowest
+        self._slowest: Dict[str, List[Tuple[float, str]]] = {}
+        self.rejections: Dict[str, int] = {}
+        self.count = 0
+        self.by_endpoint: Dict[str, int] = {}
+
+    def add(self, trace: RequestTrace) -> None:
+        doc = trace.to_dict()
+        tid = doc["trace_id"]
+        ms = float(doc.get("ms") or 0.0)
+        endpoint = doc.get("endpoint") or "?"
+        with self._lock:
+            self.count += 1
+            self.by_endpoint[endpoint] = \
+                self.by_endpoint.get(endpoint, 0) + 1
+            if doc.get("status") == "rejected":
+                reason = doc.get("reason") or "unknown"
+                self.rejections[reason] = \
+                    self.rejections.get(reason, 0) + 1
+            if tid in self._by_id:
+                # a reused client X-Request-Id: the newest doc wins
+                # everywhere — release the old recency + tail slots so
+                # one id never holds two of them
+                try:
+                    self._recent.remove(tid)
+                except ValueError:
+                    pass
+                self._recent_ids.discard(tid)
+                self._pinned_ids.discard(tid)
+                for slow in self._slowest.values():
+                    slow[:] = [(m, t) for m, t in slow if t != tid]
+            self._by_id[tid] = doc
+            self._recent.append(tid)
+            self._recent_ids.add(tid)
+            # slowest-k pinning per endpoint (completed requests only —
+            # a rejected request is fast by construction and must not
+            # occupy a tail slot)
+            if self.slowest_k and doc.get("status") != "rejected":
+                slow = self._slowest.setdefault(endpoint, [])
+                slow.append((ms, tid))
+                self._pinned_ids.add(tid)
+                slow.sort()
+                while len(slow) > self.slowest_k:
+                    _, old = slow.pop(0)
+                    self._pinned_ids.discard(old)
+                    self._evict_if_unpinned(old)
+            while len(self._recent) > self.ring:
+                old = self._recent.popleft()
+                self._recent_ids.discard(old)
+                self._evict_if_unpinned(old)
+
+    def _evict_if_unpinned(self, tid: str) -> None:
+        if tid in self._recent_ids or tid in self._pinned_ids:
+            return
+        self._by_id.pop(tid, None)
+
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            doc = self._by_id.get(trace_id)
+            return dict(doc) if doc is not None else None
+
+    def slowest(self, endpoint: Optional[str] = None,
+                n: int = 1) -> List[Dict[str, Any]]:
+        with self._lock:
+            pairs: List[Tuple[float, str]] = []
+            for ep, slow in self._slowest.items():
+                if endpoint is None or ep == endpoint:
+                    pairs.extend(slow)
+            pairs.sort(reverse=True)
+            return [dict(self._by_id[t]) for _, t in pairs[:n]
+                    if t in self._by_id]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            slowest = {ep: [{"trace_id": t, "ms": m,
+                             "blame": (self._by_id.get(t) or {}
+                                       ).get("blame")}
+                            for m, t in sorted(slow, reverse=True)]
+                       for ep, slow in self._slowest.items()}
+            return {"count": self.count,
+                    "by_endpoint": dict(self.by_endpoint),
+                    "kept": len(self._by_id),
+                    "ring": self.ring,
+                    "slowest_k": self.slowest_k,
+                    "slowest": slowest,
+                    "rejections": dict(self.rejections)}
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram -> OpenMetrics exposition.  The
+    ``le`` bounds are :data:`LATENCY_BUCKETS_MS` (log-spaced, stable),
+    so an external scraper can compute ANY quantile — the ring-buffer
+    p50/p99 gauges stay for ``tpu_watch.sh``, this is for Prometheus."""
+
+    def __init__(self, buckets: Tuple[float, ...] = LATENCY_BUCKETS_MS):
+        self.bounds = tuple(buckets)
+        self._counts = [0] * (len(self.bounds) + 1)  # +Inf last
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, ms: float) -> None:
+        ms = float(ms)
+        if not math.isfinite(ms):
+            return
+        with self._lock:
+            self._sum += ms
+            self._count += 1
+            for i, bound in enumerate(self.bounds):
+                if ms <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def openmetrics(self, name: str, labels: str = "",
+                    type_line: bool = True) -> List[str]:
+        """Exposition lines (cumulative ``_bucket`` counts, ``_sum``,
+        ``_count``).  ``labels`` is the rendered label body WITHOUT
+        braces (e.g. ``model="lenet",endpoint="predict"``).  Pass
+        ``type_line=False`` for the second-and-later label sets of one
+        metric family — the exposition format allows exactly one
+        ``# TYPE`` line per family, and a duplicate makes strict
+        scrapers drop the whole scrape."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        lines = [f"# TYPE {name} histogram"] if type_line else []
+        sep = "," if labels else ""
+        cum = 0
+        for bound, c in zip(self.bounds, counts[:-1]):
+            cum += c
+            lines.append(f'{name}_bucket{{{labels}{sep}le="{bound:g}"}} '
+                         f"{cum}")
+        lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {total}')
+        body = f"{{{labels}}}" if labels else ""
+        lines.append(f"{name}_sum{body} {s:g}")
+        lines.append(f"{name}_count{body} {total}")
+        return lines
+
+
+class SLOTracker:
+    """Declared latency budgets -> live burn rates + violation ledger.
+
+    ``p99_ms`` budgets the request-completion p99; ``ttft_ms`` budgets
+    time-to-first-token (generation).  Burn = observed windowed p99 /
+    budget — 1.0x means the budget is exactly spent, the dashboards'
+    multi-window burn-rate alerts divide these.  Every request OVER its
+    budget counts as a violation; the ledger keeps the trace ids of the
+    :data:`VIOLATING_KEEP` WORST violators by budget overshoot (not the
+    newest — under a sustained burn the early catastrophic requests are
+    exactly the evidence worth keeping), so the proof for "we burned
+    the budget" is always one ``/v1/trace/<id>`` away."""
+
+    def __init__(self, p99_ms: Optional[float] = None,
+                 ttft_ms: Optional[float] = None, window: int = 1024):
+        # 0 is not "no budget": a falsy check would silently DISABLE
+        # the gate for --slo-p99-ms 0 — reject it loudly instead (burn
+        # and severity both divide by the budget, so 0 can't mean
+        # "everything violates" either)
+        for name, v in (("p99_ms", p99_ms), ("ttft_ms", ttft_ms)):
+            if v is not None and not (float(v) > 0):
+                raise ValueError(f"SLO {name} budget must be > 0 "
+                                 f"(got {v!r}); omit it for no budget")
+        self.p99_ms = float(p99_ms) if p99_ms is not None else None
+        self.ttft_ms = float(ttft_ms) if ttft_ms is not None else None
+        self._lat: collections.deque = collections.deque(maxlen=window)
+        self._ttft: collections.deque = collections.deque(maxlen=window)
+        self.violations = 0
+        # descending by severity (max observed/budget ratio), worst
+        # VIOLATING_KEEP kept
+        self._violating: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._last_gauges = 0.0
+
+    def active(self) -> bool:
+        return self.p99_ms is not None or self.ttft_ms is not None
+
+    def observe(self, ms: Optional[float], trace_id: str,
+                ttft_ms: Optional[float] = None) -> List[str]:
+        """Record one completed request; returns the budgets it violated
+        (``["p99"]``, ``["ttft"]``, both, or ``[]``)."""
+        violated: List[str] = []
+        with self._lock:
+            if ms is not None:
+                self._lat.append(float(ms))
+                if self.p99_ms is not None and ms > self.p99_ms:
+                    violated.append("p99")
+            if ttft_ms is not None:
+                self._ttft.append(float(ttft_ms))
+                if self.ttft_ms is not None and ttft_ms > self.ttft_ms:
+                    violated.append("ttft")
+            if violated:
+                self.violations += 1
+                severity = 0.0
+                if "p99" in violated and ms is not None:
+                    severity = max(severity, ms / self.p99_ms)
+                if "ttft" in violated and ttft_ms is not None:
+                    severity = max(severity, ttft_ms / self.ttft_ms)
+                self._violating.append(
+                    {"trace_id": trace_id, "ms": round(ms or 0.0, 3),
+                     "ttft_ms": (round(ttft_ms, 3)
+                                 if ttft_ms is not None else None),
+                     "violated": violated,
+                     "severity": round(severity, 3)})
+                self._violating.sort(key=lambda v: -v["severity"])
+                del self._violating[VIOLATING_KEEP:]
+        return violated
+
+    @staticmethod
+    def _p99(dq: collections.deque) -> Optional[float]:
+        # None (not 0.0) when empty: burn is undefined with no data
+        return _percentile(list(dq), 99.0) if dq else None
+
+    def burn(self) -> Dict[str, Any]:
+        with self._lock:
+            lat_p99 = self._p99(self._lat)
+            ttft_p99 = self._p99(self._ttft)
+        out: Dict[str, Any] = {}
+        if self.p99_ms is not None:
+            out["p99"] = {"budget_ms": self.p99_ms,
+                          "observed_ms": lat_p99,
+                          "burn": round(lat_p99 / self.p99_ms, 3)
+                          if lat_p99 is not None else None}
+        if self.ttft_ms is not None:
+            out["ttft"] = {"budget_ms": self.ttft_ms,
+                           "observed_ms": ttft_p99,
+                           "burn": round(ttft_p99 / self.ttft_ms, 3)
+                           if ttft_p99 is not None else None}
+        return out
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            violating = list(self._violating)
+        return {"budgets": {"p99_ms": self.p99_ms,
+                            "ttft_ms": self.ttft_ms},
+                "burn": self.burn(), "violations": self.violations,
+                "violating": violating}
+
+    def maybe_gauges(self, min_interval_s: float = 1.0) -> None:
+        """Publish the burn rates as run-log gauges, rate-limited — the
+        FleetWatcher and ``telemetry diff`` read the log, Prometheus
+        reads ``/metrics`` directly."""
+        if not self.active():
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_gauges < min_interval_s:
+                return
+            self._last_gauges = now
+        burn = self.burn()
+        p99 = (burn.get("p99") or {}).get("burn")
+        if p99 is not None:
+            _telemetry.gauge("serve/slo_p99_burn", p99)
+        ttft = (burn.get("ttft") or {}).get("burn")
+        if ttft is not None:
+            _telemetry.gauge("serve/slo_ttft_burn", ttft)
+
+
+class RequestFold:
+    """The one fold of run-log ``request`` events shared by every live
+    consumer (the MetricsSink and the FleetWatcher's per-host state):
+    counts, per-endpoint totals, per-reason rejections, SLO violations,
+    and the slowest completed request seen.  One implementation so the
+    two views can never diverge on the event shape.  Not locked — each
+    consumer folds under its own synchronization."""
+
+    __slots__ = ("count", "by_endpoint", "rejections", "slo_violations",
+                 "slowest")
+
+    def __init__(self):
+        self.count = 0
+        self.by_endpoint: Dict[str, int] = {}
+        self.rejections: Dict[str, int] = {}
+        self.slo_violations = 0
+        self.slowest: Dict[str, Any] = {}
+
+    def fold(self, ev: Dict[str, Any]) -> None:
+        self.count += 1
+        ep = str(ev.get("endpoint", "?"))
+        self.by_endpoint[ep] = self.by_endpoint.get(ep, 0) + 1
+        if ev.get("status") == "rejected":
+            reason = str(ev.get("reason") or "unknown")
+            self.rejections[reason] = self.rejections.get(reason, 0) + 1
+        # not elif: a 504 dispatch timeout is BOTH rejected and (with
+        # its full wall observed) an SLO violation
+        if ev.get("slo_violated"):
+            self.slo_violations += 1
+        ms = float(ev.get("ms", 0.0) or 0.0)
+        if ev.get("status") != "rejected" \
+                and ms > float(self.slowest.get("ms", 0.0)):
+            self.slowest = {"trace_id": ev.get("trace_id"),
+                            "endpoint": ep, "ms": round(ms, 3),
+                            "blame": (ev.get("blame") or {}).get("cause")}
+
+
+# -- offline readers ----------------------------------------------------------
+def request_events(events: Iterable[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    """The ``request`` events out of a parsed run log."""
+    return [e for e in events if e.get("kind") == "request"]
+
+
+def summarize_requests(events: Iterable[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """Aggregate view of a run log's request traces: counts, latency
+    percentiles and slowest ids per endpoint, rejection reasons — the
+    offline twin of ``/status.traces``."""
+    reqs = request_events(events)
+    by_ep: Dict[str, List[Dict[str, Any]]] = {}
+    rejections: Dict[str, int] = {}
+    for r in reqs:
+        by_ep.setdefault(r.get("endpoint") or "?", []).append(r)
+        if r.get("status") == "rejected":
+            reason = r.get("reason") or "unknown"
+            rejections[reason] = rejections.get(reason, 0) + 1
+    endpoints: Dict[str, Any] = {}
+    for ep, rows in sorted(by_ep.items()):
+        done = [r for r in rows if r.get("status") != "rejected"]
+        lats = [float(r.get("ms") or 0.0) for r in done]
+
+        def pct(p: float) -> Optional[float]:
+            return _percentile(lats, p) if lats else None
+
+        slowest = sorted(done, key=lambda r: float(r.get("ms") or 0.0),
+                         reverse=True)
+        endpoints[ep] = {
+            "count": len(rows), "completed": len(done),
+            "p50_ms": pct(50.0), "p99_ms": pct(99.0),
+            "slowest": [{"trace_id": r.get("trace_id"),
+                         "ms": r.get("ms"),
+                         "blame": (r.get("blame") or {}).get("cause")}
+                        for r in slowest[:5]]}
+    return {"requests": len(reqs), "endpoints": endpoints,
+            "rejections": rejections}
+
+
+def format_trace(doc: Dict[str, Any]) -> str:
+    """One request's text waterfall — offsets from ingress, one line
+    per span, the blame verdict and component tally at the end."""
+    t0 = float(doc.get("t0") or doc.get("ts") or 0.0)
+    head = (f"== request {doc.get('trace_id')} "
+            f"[{doc.get('endpoint')}] {doc.get('ms', 0.0):.1f} ms "
+            f"{doc.get('status', '?')}")
+    if doc.get("reason"):
+        head += f" ({doc['reason']})"
+    blame = doc.get("blame") or {}
+    if blame.get("cause"):
+        head += (f"  blame={blame['cause']}"
+                 f"(+{blame.get('excess_ms', 0.0):.1f}ms over baseline "
+                 f"{blame.get('baseline_ms', 0.0):.1f}ms)")
+    lines = [head + " =="]
+    for s in doc.get("spans") or []:
+        off = (float(s.get("t0", t0)) - t0) * 1000.0
+        extra = {k: v for k, v in s.items()
+                 if k not in ("name", "t0", "ms")}
+        tail = f"  {extra}" if extra else ""
+        lines.append(f"  {off:9.1f}ms  {s.get('name', '?'):<22} "
+                     f"{float(s.get('ms', 0.0)):9.2f}ms{tail}")
+    if doc.get("spans_dropped"):
+        lines.append(f"  ... {doc['spans_dropped']} span(s) past the "
+                     f"cap (tallied in components)")
+    comp = doc.get("components") or {}
+    if comp:
+        body = "  ".join(f"{k}={v:.1f}ms" for k, v in
+                         sorted(comp.items(), key=lambda kv: -kv[1]))
+        lines.append(f"  components: {body}")
+    if doc.get("token_ts"):
+        lines.append(f"  tokens: {len(doc['token_ts'])} emitted, "
+                     f"ttft {doc.get('ttft_ms', '?')} ms")
+    return "\n".join(lines)
+
+
+def trace_main(argv=None) -> int:
+    """``python -m bigdl_tpu.telemetry trace run.jsonl [--slowest N]``
+    — render request waterfalls offline from a run log's ``request``
+    events.  Exit 2 when the log has none."""
+    import argparse
+    import sys
+
+    from bigdl_tpu.telemetry import schema
+
+    p = argparse.ArgumentParser(
+        prog="bigdl_tpu.telemetry trace",
+        description="per-request waterfalls from a serving run log "
+                    "(kind 'request' events)")
+    p.add_argument("run", metavar="run.jsonl")
+    p.add_argument("--slowest", type=int, default=3, metavar="N",
+                   help="render the N slowest completed requests "
+                        "(default %(default)s)")
+    p.add_argument("--id", default=None, metavar="TRACE_ID",
+                   help="render exactly this trace id instead")
+    p.add_argument("--chrome", metavar="OUT.json", default=None,
+                   help="also write request-lane Chrome/Perfetto "
+                        "waterfalls")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    events, parse_errors = schema.read_events(args.run)
+    for e in parse_errors:
+        print(f"warning: {args.run}: {e}", file=sys.stderr)
+    reqs = request_events(events)
+    if not reqs:
+        print(f"error: {args.run} has no request events (serving runs "
+              f"emit one per request under BIGDL_TRACE, default on)",
+              file=sys.stderr)
+        return 2
+    if args.id is not None:
+        picked = [r for r in reqs if r.get("trace_id") == args.id]
+        if not picked:
+            print(f"error: trace id {args.id!r} not in {args.run}",
+                  file=sys.stderr)
+            return 2
+    else:
+        done = [r for r in reqs if r.get("status") != "rejected"]
+        picked = sorted(done, key=lambda r: float(r.get("ms") or 0.0),
+                        reverse=True)[:max(1, args.slowest)]
+    summary = summarize_requests(events)
+    if args.json:
+        print(json.dumps({"summary": summary, "traces": picked},
+                         indent=2, default=str))
+    else:
+        eps = summary["endpoints"]
+        head = ", ".join(
+            f"{ep}: {v['count']} (p50 {v['p50_ms']} ms, p99 "
+            f"{v['p99_ms']} ms)" for ep, v in eps.items())
+        print(f"== {summary['requests']} request(s) — {head} ==")
+        if summary["rejections"]:
+            print(f"rejections: {summary['rejections']}")
+        for doc in picked:
+            print()
+            print(format_trace(doc))
+    if args.chrome:
+        from bigdl_tpu.telemetry.chrome_trace import write_chrome_trace
+
+        n = write_chrome_trace(picked, args.chrome)
+        print(f"\nchrome trace: {args.chrome} ({n} trace events, "
+              f"{len(picked)} request lanes) — open in chrome://tracing "
+              f"or https://ui.perfetto.dev",
+              file=sys.stderr if args.json else sys.stdout)
+    return 0

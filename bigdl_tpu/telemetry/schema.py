@@ -60,6 +60,16 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # the raw material for the bigdl_gen_* metrics and the fleet view's
     # decode-replica columns
     "generate": {"tokens": (int,), "dur": _NUM},
+    # one per serving request (telemetry/request_trace.py): the span
+    # timeline + component tally + blame verdict of one request's trip
+    # through the server.  trace_id = the X-Request-Id echoed to the
+    # client, endpoint = predict|generate, ms = ingress-to-done wall,
+    # status = ok|rejected|error|cancelled; spans / components / blame /
+    # reason / ttft_ms / slo_violated travel as extra fields — the raw
+    # material for `telemetry trace`, the chrome request lanes, and the
+    # fleet SLO columns
+    "request": {"trace_id": (str,), "endpoint": (str,), "ms": _NUM,
+                "status": (str,)},
     # per-collective comms attribution (telemetry/comms.py): count =
     # collective ops in the compiled step, bytes = HloCostAnalysis-style
     # bytes accessed; payload_bytes / by_axis / by_op / rows /
@@ -95,6 +105,11 @@ STREAM_NAMES = frozenset({
     # "Autoregressive generation"): tokens-emitted counter per coalesced
     # decode iteration, live active-sequence + KV-cache-occupancy gauges
     "serve/generate", "serve/active_seqs", "serve/cache_occupancy",
+    # SLO burn accounting (telemetry/request_trace.py SLOTracker):
+    # observed windowed p99 / declared budget, published rate-limited
+    # into the run log so the FleetWatcher and `telemetry diff` see the
+    # burn without scraping /metrics
+    "serve/slo_p99_burn", "serve/slo_ttft_burn",
     # instants
     "epoch", "checkpoint/saved", "straggler/timeout", "run/retry",
     "metrics/serving", "profile/armed", "profile/captured",

@@ -50,6 +50,10 @@ test (or an embedding application) can inject overrides with
 | cluster_deadline       | BIGDL_CLUSTER_DEADLINE      | peer-heartbeat deadline seconds (0 = derive from the straggler budget, else 120s) |
 | heartbeat_interval     | BIGDL_HEARTBEAT_INTERVAL    | heartbeat publish/poll throttle seconds (default 1.0) |
 | scan_layers            | BIGDL_SCAN_LAYERS           | build registry models with repeated blocks stacked into ScanLayers (docs/compile.md; default off) |
+| trace_requests         | BIGDL_TRACE                 | per-request serving traces (telemetry/request_trace.py): span timelines, /v1/trace/<id>, blame verdicts (default on; off disables recording) |
+| trace_ring             | BIGDL_TRACE_RING            | recent-trace ring size per server (default 512) |
+| trace_slowest          | BIGDL_TRACE_SLOWEST         | always-kept slowest-k traces per endpoint — the p99 exemplars eviction can never touch (default 8) |
+| trace_spans            | BIGDL_TRACE_SPANS           | per-trace span cap; decode iterations past it are tallied in components, not recorded (default 512) |
 
 Performance knobs read directly at their consumer (hardware-tuning
 surface, not part of the typed object because they are read at trace
@@ -175,6 +179,13 @@ class BigDLConfig:
     # registry models with repeated-block runs stacked into ScanLayers
     # so XLA compiles ONE block body instead of N
     scan_layers: bool = False
+    # request-level serving traces (telemetry/request_trace.py,
+    # docs/observability.md "Tracing a request"): recording on/off,
+    # recent-ring size, pinned slowest-k per endpoint, per-trace span cap
+    trace_requests: bool = True
+    trace_ring: int = 512
+    trace_slowest: int = 8
+    trace_spans: int = 512
 
     @classmethod
     def from_env(cls, env=os.environ) -> "BigDLConfig":
@@ -236,6 +247,11 @@ class BigDLConfig:
             cluster_deadline=_float("BIGDL_CLUSTER_DEADLINE", 0.0),
             heartbeat_interval=_float("BIGDL_HEARTBEAT_INTERVAL", 1.0),
             scan_layers=_truthy(env.get("BIGDL_SCAN_LAYERS")),
+            trace_requests=(env.get("BIGDL_TRACE") or "on").strip().lower()
+            not in ("0", "off", "false", "no"),
+            trace_ring=_int("BIGDL_TRACE_RING", 512),
+            trace_slowest=_int("BIGDL_TRACE_SLOWEST", 8),
+            trace_spans=_int("BIGDL_TRACE_SPANS", 512),
         )
 
 

@@ -51,6 +51,11 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           # cross-host aggregator behind /status.fleet + skew blame
           "bigdl_tpu/telemetry/comms.py",
           "bigdl_tpu/telemetry/fleet.py",
+          # request-level serving traces (ISSUE 14): the span-timeline
+          # store behind /v1/trace/<id>, the per-request blame verdict,
+          # and the SLO burn gates — a silent drop reverts serving
+          # observability to aggregate percentiles with no evidence
+          "bigdl_tpu/telemetry/request_trace.py",
           # memory observability (ISSUE 11): the HBM walker behind the
           # peak_hbm_bytes diff gate, the fit estimator, and the
           # OOM-forensics evidence — a silent drop reverts device OOMs

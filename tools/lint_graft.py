@@ -49,6 +49,11 @@ PINNED_MODULES = [
     # log merges with no skew blame
     "bigdl_tpu/telemetry/comms.py",
     "bigdl_tpu/telemetry/fleet.py",
+    # request-level serving traces (ISSUE 14): losing this blinds the
+    # per-request waterfalls, the slow-request blame verdict, and the
+    # SLO burn gate — "one user's request was slow" reverts to an
+    # unanswerable aggregate p99
+    "bigdl_tpu/telemetry/request_trace.py",
     # memory observability (ISSUE 11): losing memory.py blinds the
     # peak_hbm_bytes gate (the ZeRO "optimizer HBM dropped" proof), the
     # fit estimator, and OOM forensics — device OOMs revert to a bare

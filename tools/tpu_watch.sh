@@ -114,6 +114,31 @@ if srv:
                  f" active={gen.get('active_seqs', 0)}"
                  f"/{gen.get('max_active', '?')}"
                  f" cache={gen.get('cache_occupancy', 0)}")
+    # SLO burn + tail evidence (telemetry/request_trace.py): burn is
+    # observed windowed p99 / declared budget (1.0x = budget exactly
+    # spent); the slowest retained trace id is the exemplar a
+    # babysitter feeds to GET /v1/trace/<id> for the waterfall + blame
+    slo = srv.get("slo") or {}
+    burn = slo.get("burn") or {}
+    cells = []
+    for which in ("p99", "ttft"):
+        b = (burn.get(which) or {}).get("burn")
+        if b is not None:
+            cells.append(f"{which} {b}x")
+    if cells:
+        line += " slo=" + "/".join(cells)
+        if slo.get("violations"):
+            line += f"!viol{slo['violations']}"
+    slowest = []
+    for ep, rows in ((srv.get("traces") or {}).get("slowest")
+                     or {}).items():
+        if rows:
+            slowest.append((rows[0].get("ms", 0), rows[0], ep))
+    if slowest:
+        ms, row, ep = max(slowest, key=lambda t: t[0])
+        line += f" slowest={row.get('trace_id', '?')}@{ms:.0f}ms"
+        if (row.get("blame") or {}).get("cause"):
+            line += f":{row['blame']['cause']}"
     if srv.get("draining"):
         line += " DRAINING"
 # cluster fault tolerance (parallel/cluster.py): the per-peer heartbeat
