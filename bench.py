@@ -76,8 +76,24 @@ def int8_peak_ratio() -> float:
     return 2.0
 
 
+def zipf_indices(rng, shape, vocab: int, a: float = 1.05) -> np.ndarray:
+    """Zipfian ids over ``[0, vocab)``: rank r drawn with P(r) ~ r^-a —
+    the hot-row skew real token/id traffic actually has.  The uniform
+    sampler the bench used before is the BEST case for an embedding
+    (every row equally warm, no hot-row cache/contention behaviour and
+    maximal unique rows per batch); embedding legs sample zipfian so the
+    sparse-sync win and hot-row behaviour are measured under realistic
+    skew (docs/sparse.md)."""
+    ranks = np.arange(1, vocab + 1, dtype=np.float64)
+    p = ranks ** -a
+    p /= p.sum()
+    return rng.choice(vocab, size=shape, p=p).astype(np.int32)
+
+
 def _configs():
-    """name -> (build_model, build_batch, criterion, batch)."""
+    """name -> (build_model, build_batch, criterion, batch).
+    ``build_batch(batch, seq=None)``: token configs honor a sequence
+    override (the bucketed lstm protocol); image configs ignore it."""
     from bigdl_tpu import models
     import bigdl_tpu.nn as nn
 
@@ -88,12 +104,25 @@ def _configs():
         y = jnp.asarray(rng.integers(0, classes, batch))
         return x, y
 
-    def tokens(batch, seq, vocab, classes, seq_targets=False):
-        x = jnp.asarray(rng.integers(0, vocab, (batch, seq), dtype=np.int32))
+    def tokens(batch, seq, vocab, classes, seq_targets=False, zipf=None):
+        if zipf is not None:
+            x = jnp.asarray(zipf_indices(rng, (batch, seq), vocab, zipf))
+        else:
+            x = jnp.asarray(rng.integers(0, vocab, (batch, seq),
+                                         dtype=np.int32))
         if seq_targets:  # LM: a target token per position
             y = jnp.asarray(rng.integers(0, classes, (batch, seq), dtype=np.int32))
         else:
             y = jnp.asarray(rng.integers(0, classes, batch))
+        return x, y
+
+    def dlrm_batch(batch):
+        # Criteo-style: 13 integer count features + 8 zipfian
+        # categorical ids, one per 50000-row table (models/dlrm.py)
+        dense = rng.integers(0, 100, (batch, 13), dtype=np.int32)
+        cat = zipf_indices(rng, (batch, 8), 50000, 1.05)
+        x = jnp.asarray(np.concatenate([dense, cat], axis=1))
+        y = jnp.asarray(rng.integers(0, 2, batch))
         return x, y
 
     return {
@@ -106,17 +135,33 @@ def _configs():
         "inception_v1_imagenet": (
             lambda: models.build_inception_v1(1000),
             lambda b: img(b, 3, 224, 224, 1000), nn.ClassNLLCriterion(), 256),
+        # zipfian ids since r15 (realistic hot-row skew; uniform was the
+        # embedding's best case) and the BUCKETED variable-length
+        # protocol (LSTM_BUCKETS below; BENCH_LSTM_BUCKETS=0 restores
+        # the fixed-200 leg for old-round comparisons)
         "lstm_text": (
             lambda: models.build_lstm_classifier(5000, class_num=20),
-            lambda b: tokens(b, 200, 5000, 20), nn.ClassNLLCriterion(), 256),
+            lambda b, s=None: tokens(b, s or 200, 5000, 20, zipf=1.05),
+            nn.ClassNLLCriterion(), 256),
         # representative large recurrent shape: the tiny config above is
         # latency-bound (see BASELINE.md roofline note); this one feeds
-        # the MXU a 1536x4096 fused-gate matmul per scan step
+        # the MXU a 1536x4096 fused-gate matmul per scan step.  Its
+        # 102400-lookup batch touches the whole 20000-row table, so the
+        # sparse auto rule keeps its sync DENSE (docs/sparse.md "when
+        # dense wins") — the sparse-sync proof shape is `dlrm`
         "lstm_text_large": (
             lambda: models.build_lstm_classifier(
                 20000, embed_dim=512, hidden_size=1024, num_layers=2,
                 class_num=20),
-            lambda b: tokens(b, 200, 20000, 20), nn.ClassNLLCriterion(), 512),
+            lambda b, s=None: tokens(b, s or 200, 20000, 20, zipf=1.05),
+            nn.ClassNLLCriterion(), 512),
+        # recsys ranking (models/dlrm.py, docs/sparse.md): 8 x 50000-row
+        # embedding bags + MLPs + pairwise interaction; a batch touches
+        # <= 512 of each table's 50000 rows, so the sparse sync moves
+        # ~2% of the dense table all-reduce — the measured sparse win
+        "dlrm": (
+            lambda: models.build_dlrm(),
+            lambda b, s=None: dlrm_batch(b), nn.ClassNLLCriterion(), 512),
         "resnet50_imagenet": (
             lambda: models.build_resnet(50, 1000),
             lambda b: img(b, 3, 224, 224, 1000), nn.ClassNLLCriterion(), 128),
@@ -154,12 +199,13 @@ def peak_flops_per_sec():
     return None
 
 
-def make_step(name: str, batch: int = None):
+def make_step(name: str, batch: int = None, seq: int = None):
     """Build the exact train step a config benches — the shared setup
     recipe (seed, graph passes, SGD 0.9-momentum, bf16 compute) for
     bench.run_config, tools/profile_bench.py, and tools/hlo_dump.py so
     their runtime and compiler views stay views of the SAME program.
-    Returns (step, x, y)."""
+    ``seq`` overrides the sequence length on token configs that honor it
+    (the bucketed lstm protocol).  Returns (step, x, y)."""
     import bigdl_tpu.optim as optim
     from bigdl_tpu.nn.fuse import optimize_for_tpu
     from bigdl_tpu.parallel.train_step import TrainStep
@@ -171,7 +217,10 @@ def make_step(name: str, batch: int = None):
     step = TrainStep(model, criterion,
                      optim.SGD(learning_rate=0.01, momentum=0.9),
                      compute_dtype=jnp.bfloat16)
-    x, y = build_batch(batch or default_batch)
+    if seq is None:
+        x, y = build_batch(batch or default_batch)
+    else:
+        x, y = build_batch(batch or default_batch, seq)
     return step, x, y
 
 
@@ -233,23 +282,31 @@ def _flash_attn_flops(name, batch):
     return 12.0 * layers * batch * heads * float(s) * s * d
 
 
+#: configs riding the bucketed variable-length protocol (dataset/
+#: text.py BucketedPadding boundaries): batches are drawn per length
+#: bucket instead of always padding to max seq, and MFU stops crediting
+#: pad positions.  BENCH_LSTM_BUCKETS=0 restores the fixed-length leg
+#: (comparisons against pre-r15 banked rounds).
+LSTM_BUCKETS = {"lstm_text": (32, 64, 128, 200)}
+
+
 def run_config(name, batch, iters):
     from bigdl_tpu import telemetry
 
     with telemetry.span(f"bench/{name}", batch=batch, iters=iters):
+        if name in LSTM_BUCKETS \
+                and os.environ.get("BENCH_LSTM_BUCKETS", "1") != "0":
+            return _run_config_bucketed(name, batch, iters,
+                                        LSTM_BUCKETS[name])
         return _run_config_timed(name, batch, iters)
 
 
-def _run_config_timed(name, batch, iters):
-    from bigdl_tpu import telemetry
-
-    step, x, y = make_step(name, batch)
-
-    # ALL timed iterations run inside ONE dispatch (lax.scan over the
-    # step) — per-dispatch latency is a property of the host link, not of
-    # the training program, and a real TPU deployment amortizes it the
-    # same way.  The AOT compile also yields XLA's cost analysis (scan
-    # body counted once).
+def _time_leg(name, step, x, y, iters):
+    """The shared timing core: one AOT scan compile (heartbeat-guarded),
+    cost analysis, an untimed warmup dispatch, then the timed window.
+    Returns ``(wall_s, compile_s, stages, flops_per_iter)`` —
+    ``flops_per_iter`` is the raw XLA count (pad masking is the
+    caller's accounting)."""
     import threading
 
     flops = None
@@ -266,11 +323,8 @@ def _run_config_timed(name, batch, iters):
 
     cost = normalize_cost_analysis(cost)
     compile_s = time.perf_counter() - t_c0
-    flash_flops = 0.0
     if cost and cost.get("flops"):
         flops = float(cost["flops"])
-        flash_flops = _flash_attn_flops(name, batch)
-        flops += flash_flops
 
     drain = make_drain(step)
 
@@ -286,14 +340,132 @@ def _run_config_timed(name, batch, iters):
     t_dispatch = time.perf_counter()
     drain()
     wall = time.perf_counter() - t0
+    stages = {"compile": round(compile_s, 3),
+              "h2d": round(t_h2d - t0, 4),
+              "dispatch": round(t_dispatch - t_h2d, 4),
+              "device": round(wall - (t_dispatch - t0), 4)}
+    return wall, compile_s, stages, flops
+
+
+def _bucket_lengths(rng, n, max_len):
+    """Realistic sentence lengths for the bucketed lstm leg: lognormal
+    (median ~45 tokens, long tail clipped at the model's max seq) — the
+    shape short-text classification corpora actually have, instead of
+    every row exactly max_len."""
+    ln = np.round(rng.lognormal(np.log(45.0), 0.8, size=n))
+    return np.clip(ln, 4, max_len).astype(int)
+
+
+def _run_config_bucketed(name, batch, iters, boundaries):
+    """The variable-length protocol (dataset/text.py BucketedPadding):
+    sample realistic lengths, assign each row to its bucket, run the
+    timed scan once per bucket holding >= 5% of rows (iterations split
+    by share), aggregate.  MFU accounting multiplies each bucket's XLA
+    FLOPs by its valid-token fraction — pad positions compute but no
+    longer count as useful work."""
+    from bigdl_tpu import telemetry
+    from bigdl_tpu.dataset.text import BucketedPadding
+
+    bp = BucketedPadding(boundaries)
+    rng = np.random.default_rng(7)
+    lengths = _bucket_lengths(rng, 4096, boundaries[-1])
+    by_bucket = {}
+    for ln in lengths:
+        by_bucket.setdefault(bp.bucket_of(int(ln)), []).append(int(ln))
+    shares = {b: len(v) / len(lengths) for b, v in by_bucket.items()}
+    legs = {b: v for b, v in by_bucket.items() if shares[b] >= 0.05}
+    scale = sum(shares[b] for b in legs)  # renormalize dropped tails
+    total_rows = 0
+    total_wall = 0.0
+    useful_flops = 0.0
+    compile_s_total = 0.0
+    stages_total = {"compile": 0.0, "h2d": 0.0, "dispatch": 0.0,
+                    "device": 0.0}
+    buckets_out = {}
+    peak_hbm = None
+    for b_seq in sorted(legs):
+        iters_b = max(2, int(round(iters * shares[b_seq] / scale)))
+        step, x, y = make_step(name, batch, seq=b_seq)
+        # end-pad each row past its sampled valid length with index 0
+        # (the dataset convention) so the content matches what a
+        # bucketed input pipeline would feed
+        row_lens = rng.choice(np.asarray(legs[b_seq]), size=batch)
+        row_lens = np.minimum(row_lens, b_seq)
+        xm = np.asarray(x)
+        mask = np.arange(b_seq)[None, :] < row_lens[:, None]
+        xm = np.where(mask, xm, 0).astype(xm.dtype)
+        x = jnp.asarray(xm)
+        valid_frac = float(row_lens.sum()) / float(batch * b_seq)
+        wall, compile_s, stages, flops = _time_leg(
+            f"{name}[s{b_seq}]", step, x, y, iters_b)
+        total_rows += batch * iters_b
+        total_wall += wall
+        compile_s_total += compile_s
+        for k in stages_total:
+            stages_total[k] += stages[k]
+        if flops:
+            useful_flops += flops * valid_frac * iters_b
+        try:
+            from bigdl_tpu.telemetry import memory as _tmem
+
+            mrow = _tmem.analyze_hlo_memory(step._scan_cache[1].as_text())
+            peak_hbm = max(peak_hbm or 0, int(mrow["peak_bytes"]))
+        except Exception:  # noqa: BLE001 - the snapshot is an observer
+            pass
+        buckets_out[str(b_seq)] = {
+            "share": round(shares[b_seq] / scale, 3), "iters": iters_b,
+            "images_per_sec": round(batch * iters_b / wall, 2),
+            "valid_token_frac": round(valid_frac, 3),
+            "compile_s": round(compile_s, 3),
+        }
+    rate = total_rows / total_wall
+    telemetry.counter(f"bench/{name}/images_per_sec", rate)
+    out = {"images_per_sec": round(rate, 2), "batch": batch,
+           "compile_s": round(compile_s_total, 3),
+           "stages_s": {k: round(v, 4) for k, v in stages_total.items()},
+           "buckets": buckets_out,
+           "valid_token_frac": round(
+               sum(r["valid_token_frac"] * r["share"]
+                   for r in buckets_out.values()), 3)}
+    if useful_flops:
+        achieved = useful_flops / total_wall
+        out["step_gflops"] = round(useful_flops / max(1, total_rows
+                                                      // batch) / 1e9, 2)
+        out["achieved_tflops"] = round(achieved / 1e12, 2)
+        peak = peak_flops_per_sec()
+        if peak:
+            # pad positions excluded: this MFU counts USEFUL tokens only
+            out["mfu"] = round(achieved / peak, 4)
+    if peak_hbm:
+        out["peak_hbm_bytes"] = peak_hbm
+    return out
+
+
+def _run_config_timed(name, batch, iters):
+    from bigdl_tpu import telemetry
+
+    step, x, y = make_step(name, batch)
+
+    # ALL timed iterations run inside ONE dispatch (lax.scan over the
+    # step) — per-dispatch latency is a property of the host link, not of
+    # the training program, and a real TPU deployment amortizes it the
+    # same way.  The AOT compile also yields XLA's cost analysis (scan
+    # body counted once).
+    wall, compile_s, stages, flops = _time_leg(name, step, x, y, iters)
+    t_h2d_s = stages["h2d"]
+    t_dispatch_s = stages["dispatch"]
+    flash_flops = 0.0
+    if flops:
+        flash_flops = _flash_attn_flops(name, batch)
+        flops += flash_flops
 
     rate = batch * iters / wall
     # same numbers, second consumer: the telemetry event log (when a run
     # is active) carries the stage split + throughput next to the
     # aot_scan compile/device_facts events TrainStep already emitted
-    telemetry.stage("h2d", t_h2d - t0)
-    telemetry.stage("dispatch", t_dispatch - t_h2d)
-    telemetry.stage("device", wall - (t_dispatch - t0))
+    telemetry.stage("h2d", t_h2d_s)
+    telemetry.stage("dispatch", t_dispatch_s)
+    telemetry.stage("device", stages["device"])
     telemetry.counter(f"bench/{name}/images_per_sec", rate)
     out = {"images_per_sec": round(rate, 2), "batch": batch,
            # the compile budget's input (docs/compile.md): per-leg
@@ -304,10 +476,7 @@ def _run_config_timed(name, batch, iters):
            # host-loop stage breakdown (optim/Metrics.scala:31-130
            # re-scope; see docs/straggler.md): compile / h2d / dispatch /
            # device-sync seconds for the timed window
-           "stages_s": {"compile": round(compile_s, 3),
-                        "h2d": round(t_h2d - t0, 4),
-                        "dispatch": round(t_dispatch - t_h2d, 4),
-                        "device": round(wall - (t_dispatch - t0), 4)}}
+           "stages_s": stages}
     if flops:
         achieved = flops * iters / wall
         out["step_gflops"] = round(flops / 1e9, 2)

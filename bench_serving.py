@@ -57,6 +57,8 @@ Usage::
 
     python bench_serving.py --model lenet --qps 100 --duration 10
     python bench_serving.py --model lenet --diff-against BENCH_serving.json
+    python bench_serving.py --model dlrm --qps 100 --duration 12 \
+        --diff-against BENCH_SERVING_cpu_r15.json   # the recsys tenant
     python bench_serving.py --model lenet --qps 100 --slo-p99-ms 50
     python bench_serving.py --model transformer --generate --qps 5 \
         --duration 10 --gen-mix 8,24,64 --max-new-tokens 16

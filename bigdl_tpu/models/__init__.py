@@ -8,6 +8,7 @@ analyzer (``python -m bigdl_tpu.analysis <name>``).
 from bigdl_tpu.models import registry  # noqa: F401
 
 from bigdl_tpu.models.autoencoder import build_autoencoder  # noqa: F401
+from bigdl_tpu.models.dlrm import build_dlrm  # noqa: F401
 from bigdl_tpu.models.inception import (  # noqa: F401
     build_inception_v1, build_inception_v2, inception_layer_v1,
 )

@@ -478,7 +478,7 @@ def cmd_attribute(args) -> None:
 
         result = comms.attribute_comms_model(
             args.model, batch=args.batch_size, devices=args.mesh,
-            sync=args.sync)
+            sync=args.sync, sparse=args.sparse)
         print(json.dumps(result, indent=2, default=str) if args.json
               else comms.format_comms(result))
         return
@@ -682,6 +682,11 @@ def main(argv=None) -> None:
                     choices=("allreduce", "sharded", "fsdp"),
                     help="(--comms/--memory) parameter_sync mode to "
                          "compile with")
+    at.add_argument("--sparse", default=None,
+                    choices=("off", "auto", "on"),
+                    help="(--comms) override BIGDL_SPARSE for this "
+                         "compile — A/B the sparse embedding sync "
+                         "(docs/sparse.md)")
     at.add_argument("--json", action="store_true")
     # same default batch as `python -m bigdl_tpu.telemetry attribute`:
     # the two front-ends of one table must print the same numbers

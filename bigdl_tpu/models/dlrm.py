@@ -1,0 +1,115 @@
+"""DLRM-shaped recommendation/ranking model (the recsys scenario,
+ROADMAP item 3): per-feature embedding bags + bottom/top MLPs + pairwise
+feature interaction, after *Deep Learning Recommendation Model* (Naumov
+et al.) — the closest shape to real millions-of-users traffic this
+framework benchmarks (every ad/feed ranking request is one of these).
+
+Input convention (one int32 array so the registry/serving/bench plumbing
+that feeds single-array models applies unchanged — Criteo-style, where
+the "dense" features ARE integer counts): ``[batch, num_dense +
+num_sparse]``; the first ``num_dense`` columns are count features
+(log1p-transformed into the bottom MLP), the rest are categorical ids,
+one per feature, each indexing its own :class:`nn.EmbeddingBag`.
+Output: log-probabilities over ``class_num`` classes (click /
+no-click) — ``ClassNLLCriterion``-compatible like the other registry
+classifiers.
+
+The embedding tables are the model: at the default registry shape the
+tables hold ~50x the parameters of both MLPs together, and a batch
+touches at most ``batch`` rows of each ``vocab_size``-row table — the
+sparse-gradient sync (docs/sparse.md) is what makes training it
+data-parallel-scalable, and this model is its proof shape."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module
+
+__all__ = ["build_dlrm", "DLRM"]
+
+
+class DLRM(Module):
+    """See module docstring.  ``bag_size > 1`` widens each categorical
+    feature to a multi-hot bag (``[batch, num_sparse, bag_size]`` input
+    layout flattened into the trailing columns)."""
+
+    def __init__(self, num_dense: int = 13, num_sparse: int = 8,
+                 vocab_size: int = 50000, embed_dim: int = 32,
+                 bottom_dims: Sequence[int] = (64, 32),
+                 top_dims: Sequence[int] = (64, 32),
+                 class_num: int = 2, bag_size: int = 1,
+                 bag_mode: str = "sum", sparse: Optional[bool] = None,
+                 padding_idx: Optional[int] = None):
+        super().__init__()
+        self.num_dense = num_dense
+        self.num_sparse = num_sparse
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.bag_size = bag_size
+        bottom = nn.Sequential()
+        d = num_dense
+        for h in bottom_dims:
+            bottom.add(nn.Linear(d, h)).add(nn.ReLU())
+            d = h
+        bottom.add(nn.Linear(d, embed_dim)).add(nn.ReLU())
+        self.bottom = bottom
+        # one table per categorical feature (distinct cardinalities in
+        # real deployments; symmetric here), registered as numbered
+        # children so attribution rows and state-dict paths name them
+        for i in range(num_sparse):
+            setattr(self, f"embed_{i}",
+                    nn.EmbeddingBag(vocab_size, embed_dim, mode=bag_mode,
+                                    padding_idx=padding_idx,
+                                    sparse=sparse))
+        # pairwise dot-product interaction over the num_sparse embedding
+        # vectors + the bottom output: F*(F-1)/2 upper-triangle terms,
+        # concatenated with the bottom vector into the top MLP
+        f = num_sparse + 1
+        d = f * (f - 1) // 2 + embed_dim
+        top = nn.Sequential()
+        for h in top_dims:
+            top.add(nn.Linear(d, h)).add(nn.ReLU())
+            d = h
+        top.add(nn.Linear(d, class_num)).add(nn.LogSoftMax())
+        self.top = top
+
+    def update_output(self, input):
+        x = jnp.asarray(input)
+        if x.dtype in (jnp.float32, jnp.float64, jnp.bfloat16):
+            x = x.astype(jnp.int32)
+        nd, ns, bs = self.num_dense, self.num_sparse, self.bag_size
+        dense = jnp.log1p(jnp.maximum(x[:, :nd], 0).astype(jnp.float32))
+        b = self.bottom(dense)  # [B, D]
+        feats = [b]
+        cat = x[:, nd:]
+        for i in range(ns):
+            emb = getattr(self, f"embed_{i}")
+            if bs > 1:
+                ids = cat[:, i * bs:(i + 1) * bs]
+            else:
+                ids = cat[:, i]
+            feats.append(emb(ids).astype(b.dtype))  # [B, D]
+        f = jnp.stack(feats, axis=1)  # [B, F, D]
+        inter = jnp.einsum("bfd,bgd->bfg", f, f)
+        iu, ju = jnp.triu_indices(f.shape[1], k=1)
+        pairs = inter[:, iu, ju]  # [B, F*(F-1)/2]
+        return self.top(jnp.concatenate([pairs, b], axis=1))
+
+
+def build_dlrm(num_dense: int = 13, num_sparse: int = 8,
+               vocab_size: int = 50000, embed_dim: int = 32,
+               bottom_dims: Sequence[int] = (64, 32),
+               top_dims: Sequence[int] = (64, 32), class_num: int = 2,
+               bag_size: int = 1, bag_mode: str = "sum",
+               sparse: Optional[bool] = None,
+               padding_idx: Optional[int] = None) -> nn.Module:
+    """Registry builder (``models/registry.py`` name ``dlrm``)."""
+    return DLRM(num_dense=num_dense, num_sparse=num_sparse,
+                vocab_size=vocab_size, embed_dim=embed_dim,
+                bottom_dims=bottom_dims, top_dims=top_dims,
+                class_num=class_num, bag_size=bag_size, bag_mode=bag_mode,
+                sparse=sparse, padding_idx=padding_idx)

@@ -67,6 +67,10 @@ def _b(fn_name: str):
 LSTM_SEQ_LEN = 200
 LM_SEQ_LEN = 128
 LSTM_VOCAB = 5000
+#: dlrm feature geometry (models/dlrm.py defaults): 13 count features +
+#: 8 categorical ids, one per table
+DLRM_FEATURES = 13 + 8
+DLRM_VOCAB = 50000
 
 
 def _resnet_cifar(num_classes: int = 0):
@@ -99,6 +103,12 @@ def _transformer(num_classes: int = 0):
 
     return models.build_transformer_lm(vocab_size=num_classes or 256)
 
+
+def _dlrm(num_classes: int = 0):
+    from bigdl_tpu import models
+
+    return models.build_dlrm(class_num=num_classes or 2)
+
 MODELS: Dict[str, ModelEntry] = {
     "lenet": ModelEntry(_b("build_lenet5"), _flat(28 * 28)),
     "vgg16": ModelEntry(_b("build_vgg16"), _img(3, 224, 224)),
@@ -114,6 +124,9 @@ MODELS: Dict[str, ModelEntry] = {
     "autoencoder": ModelEntry(_autoencoder, _flat(28 * 28)),
     "lstm": ModelEntry(_lstm, _tokens(LSTM_SEQ_LEN)),
     "transformer": ModelEntry(_transformer, _tokens(LM_SEQ_LEN)),
+    # recsys ranking (models/dlrm.py): [batch, 13 count + 8 categorical]
+    # int32 features -> click log-probs; the sparse-sync proof shape
+    "dlrm": ModelEntry(_dlrm, _tokens(DLRM_FEATURES)),
 }
 
 
@@ -141,7 +154,7 @@ def input_spec(name: str, batch: int = 2):
 #: then falls back to forward-only rather than lowering a nonsense step.
 _CLASSIFIERS = frozenset({
     "lenet", "vgg16", "vgg19", "vgg_cifar", "inception_v1",
-    "inception_v2", "resnet", "resnet50", "lstm",
+    "inception_v2", "resnet", "resnet50", "lstm", "dlrm",
 })
 
 

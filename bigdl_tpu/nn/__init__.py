@@ -10,6 +10,7 @@ from bigdl_tpu.nn import init  # noqa: F401
 from bigdl_tpu.nn.criterion import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.activation import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.linear import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers.embedding import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.conv import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.pooling import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers.normalization import *  # noqa: F401,F403

@@ -1,0 +1,420 @@
+"""Embedding layers with a sparse-gradient fast path (ROADMAP item 3,
+per *Parallax: Sparsity-aware Data Parallel Training*, arXiv 1808.02621).
+
+The problem: an embedding forward is a gather, so its parameter gradient
+is a **scatter-add into a mostly-zero ``[vocab, dim]`` table** — and the
+data-parallel sync then all-reduces the whole mostly-zero table every
+step (lstm_text at MFU 0.02 is this bill).  Sparse and dense parameters
+deserve different sync paths: a batch touches at most
+``min(n_lookups, vocab)`` rows, so the gradient IS ``(indices, rows)``
+pairs, and only those should cross the interconnect.
+
+How the row-sparse cotangent works (the "custom VJP" is structural, not
+a ``jax.custom_vjp`` — the cotangent of a *parameter* must match its
+aval, so the table is routed around differentiation instead):
+
+- ``TrainStep`` opens a :class:`SparseCapture` around the traced
+  forward.  A sparse-active embedding then **unique-coalesces** its flat
+  index vector (``jnp.unique(size=min(L, V), fill_value=V)`` — static
+  shape, duplicate indices mapped onto one slot), gathers the touched
+  rows from the **stop-gradiented** table, and adds a zeros **proxy**
+  array fetched from the capture.  The proxy is a differentiated input
+  of the step's loss function, so its cotangent is exactly the coalesced
+  per-row gradient ``[slots, dim]`` — duplicates summed by the gather's
+  own VJP, padding-index rows masked to zero — and the dense
+  ``[vocab, dim]`` scatter never exists in the backward.
+- The capture also records each call's unique-index vector ``u`` (as a
+  loss-function aux output), so the update step can scatter-add the
+  synced rows once into the table — see
+  ``OptimMethod.update_mixed``/``_apply_sparse`` for the lazy row-wise
+  Adagrad/SGD applies.
+- Outside a capture (eager use, ``EvalStep``, serving) the layers run
+  the plain dense gather — inference never pays the coalesce.
+
+When dense wins (docs/sparse.md): the coalesce cap is
+``min(n_lookups, vocab)``, so once a batch's lookup count approaches the
+vocab (long-sequence LMs over small vocabs) the "sparse" rows are the
+table and the sync saves nothing.  ``sparse=None`` (auto) therefore
+activates only when ``2 * n_lookups <= vocab``; ``sparse=True`` forces
+the sparse path, ``sparse=False``/``BIGDL_SPARSE=off`` force dense.
+Exactness guardrails: ``max_norm`` renorm is differentiated through on
+the dense path, so a renormed table always syncs dense; a regularized
+or value-clipped-outside-zero table does too (``TrainStep`` owns those
+checks).
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, Parameter
+
+__all__ = ["LookupTable", "EmbeddingBag", "SparseCapture", "sparse_tables",
+           "sparse_enabled", "discover_proxies", "sparse_sync_stats",
+           "row_sharding_rules"]
+
+#: the active capture (None = dense everywhere).  A ContextVar so nested
+#: traces and threaded servers can never see another trace's capture.
+_CAPTURE: contextvars.ContextVar[Optional["SparseCapture"]] = \
+    contextvars.ContextVar("bigdl_sparse_capture", default=None)
+
+
+def sparse_enabled() -> bool:
+    """Global sparse-sync switch (``BIGDL_SPARSE`` off/auto/on; default
+    auto).  ``off`` kills the path process-wide — the dense-baseline leg
+    of every A/B."""
+    from bigdl_tpu.utils.config import get_config
+
+    mode = (get_config().sparse_sync or "auto").strip().lower()
+    return mode not in ("0", "off", "false", "no")
+
+
+def _sparse_forced() -> bool:
+    from bigdl_tpu.utils.config import get_config
+
+    return (get_config().sparse_sync or "auto").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
+class SparseCapture:
+    """Trace-scoped registry connecting sparse embedding layers to the
+    training step.
+
+    ``mode='discover'``: an abstract (``jax.eval_shape``) forward runs
+    under it; each sparse-active call *requests* a proxy shape, which is
+    recorded and answered with zeros.  ``mode='bind'``: the real traced
+    forward runs under it; each call *fetches* its proxy (a
+    differentiated input of the loss) by the same deterministic key
+    ``<param_path>#<call_index>`` and records its unique-index vector.
+    The forward runs once per jit trace, so call indices line up between
+    the two passes by construction."""
+
+    def __init__(self, paths: Dict[int, str],
+                 proxies: Optional[Dict[str, jax.Array]] = None):
+        #: id(module) -> param path ("features.0.weight")
+        self.paths = paths
+        self.mode = "bind" if proxies is not None else "discover"
+        self.proxies = proxies or {}
+        self.shapes: Dict[str, jax.ShapeDtypeStruct] = {}
+        #: key -> {"path", "u", "slots", "vocab", "dim"} (bind mode: the
+        #: aux the loss function returns to the update step)
+        self.aux: Dict[str, Dict[str, Any]] = {}
+        self._calls: Dict[int, int] = {}
+        self._token = None
+
+    # -- context management ------------------------------------------------
+    def __enter__(self):
+        self._token = _CAPTURE.set(self)
+        return self
+
+    def __exit__(self, *exc):
+        _CAPTURE.reset(self._token)
+        return False
+
+    # -- layer-side API ----------------------------------------------------
+    def wants(self, module) -> bool:
+        return id(module) in self.paths
+
+    def next_key(self, module) -> str:
+        n = self._calls.get(id(module), 0)
+        self._calls[id(module)] = n + 1
+        return f"{self.paths[id(module)]}#{n}"
+
+    def proxy(self, key: str, shape: Tuple[int, ...], dtype) -> jax.Array:
+        if self.mode == "discover":
+            self.shapes[key] = jax.ShapeDtypeStruct(shape, dtype)
+            return jnp.zeros(shape, dtype)
+        if key not in self.proxies:
+            # a forward that takes a different path between discovery
+            # and the real trace would silently drop this table's
+            # gradient — fail the trace loudly instead
+            raise RuntimeError(
+                f"sparse capture has no proxy for {key!r} — the traced "
+                f"forward requested a slot discovery did not see")
+        return self.proxies[key]
+
+    def record(self, key: str, u: jax.Array, vocab: int, dim: int) -> None:
+        self.aux[key] = {"path": key.split("#", 1)[0], "u": u,
+                         "slots": int(u.shape[0]), "vocab": vocab,
+                         "dim": dim}
+
+
+def current_capture() -> Optional[SparseCapture]:
+    return _CAPTURE.get()
+
+
+def sparse_tables(model: Module) -> Dict[str, Module]:
+    """``{param_path: module}`` for every sparse-capable embedding table
+    of ``model``.  A module registered under several paths (weight
+    tying) is excluded — its calls would need per-path cotangent
+    routing the proxy keying deliberately does not attempt."""
+    found: Dict[str, Module] = {}
+    owners: Dict[int, str] = {}
+    shared = set()
+    for name, m in model.named_modules():
+        if not getattr(m, "_sparse_capable", False):
+            continue
+        if getattr(m, "sparse", None) is False:
+            continue
+        if id(m) in owners:
+            shared.add(id(m))
+            continue
+        owners[id(m)] = name
+        path = f"{name}.weight" if name else "weight"
+        found[path] = m
+    return {p: m for p, m in found.items() if id(m) not in shared}
+
+
+def discover_proxies(call, paths: Dict[int, str]
+                     ) -> Tuple[Dict[str, jax.ShapeDtypeStruct],
+                                Dict[str, Dict[str, Any]]]:
+    """Abstractly evaluate ``call()`` (a thunk running the traced
+    forward; it may close over outer-trace tracers) under a discovery
+    capture to learn which proxies the real trace will request and
+    their shapes — one ``jax.eval_shape`` pass, no FLOPs.  Returns
+    ``(shapes, metas)``: proxy ShapeDtypeStructs and the static per-key
+    facts (path/slots/vocab/dim) by the same keys the bind-mode capture
+    will use."""
+    cap = SparseCapture(paths, proxies=None)
+
+    def absfn():
+        with cap:
+            call()
+        return jnp.zeros(())
+
+    jax.eval_shape(absfn)
+    metas = {k: {kk: vv for kk, vv in v.items() if kk != "u"}
+             for k, v in cap.aux.items()}
+    return cap.shapes, metas
+
+
+def _gather_rows(module, w, idx, padding_idx: Optional[int]):
+    """``w[idx]`` with the row-sparse cotangent capture when active.
+
+    ``idx`` is integer, any shape; returns ``idx.shape + (dim,)``.
+    Padding-index semantics here are *gradient-only* (the row's value is
+    still gathered; LookupTable keeps it, EmbeddingBag masks the value
+    separately): the padding row's cotangent is zeroed on both paths so
+    sparse and dense stay numerics-equal."""
+    V, D = int(w.shape[0]), int(w.shape[1])
+    cap = current_capture()
+    if cap is not None and cap.wants(module):
+        key = cap.next_key(module)
+        if module._sparse_active(idx.size, V):
+            return _sparse_gather(module, cap, key, w, idx, V, D,
+                                  padding_idx)
+    # dense path: block the padding row's gradient without touching its
+    # value — the select routes padding POSITIONS' cotangents into the
+    # stopped branch, so the table grad at the padding row is zero.
+    # O(output) and fusable with the gather (a `.at[padding_idx].set`
+    # on the table would copy the whole [vocab, dim] array per forward,
+    # a real bill for serving-sized tables).
+    rows = w[idx]
+    if padding_idx is not None:
+        rows = jnp.where((idx != padding_idx)[..., None], rows,
+                         jax.lax.stop_gradient(rows))
+    return rows
+
+
+def _sparse_gather(module, cap: SparseCapture, key: str, w, idx,
+                   V: int, D: int, padding_idx: Optional[int]):
+    flat = idx.reshape(-1)
+    slots = min(int(flat.size), V)
+    # fill_value=V: unused slots scatter out-of-bounds at update time
+    # (mode='drop'), so padding the unique set can never touch row 0
+    u, inv = jnp.unique(flat, size=slots, fill_value=V,
+                        return_inverse=True)
+    rows = jax.lax.stop_gradient(w)[jnp.clip(u, 0, V - 1)]
+    proxy = cap.proxy(key, (slots, D), rows.dtype)
+    if padding_idx is not None:
+        # zero the padding slot's cotangent inside the VJP itself —
+        # the row's VALUE (from the stop-gradiented gather) is kept
+        proxy = proxy * (u != padding_idx)[:, None].astype(proxy.dtype)
+    rows = rows + proxy
+    cap.record(key, u, V, D)
+    return rows[inv.reshape(-1)].reshape(idx.shape + (D,))
+
+
+class _EmbeddingBase(Module):
+    """Shared machinery: the table parameter, index normalization, and
+    the sparse-activation rule."""
+
+    _sparse_capable = True
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_idx: Optional[int] = None,
+                 sparse: Optional[bool] = None,
+                 one_based: bool = False):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_idx = padding_idx
+        self.sparse = sparse
+        self.one_based = one_based
+        from bigdl_tpu.nn.init import RandomNormal
+
+        self.weight_init = RandomNormal(0.0, 1.0)
+        self.weight = Parameter(self.weight_init.init((n_index, n_output)))
+
+    def reset(self):
+        self.weight = self.weight_init.init((self.n_index, self.n_output))
+
+    def _indices(self, input):
+        idx = jnp.asarray(input)
+        if idx.dtype in (jnp.float32, jnp.float64, jnp.bfloat16):
+            idx = idx.astype(jnp.int32)
+        if self.one_based:
+            idx = idx - 1
+        return idx
+
+    def _sparse_active(self, n_lookup: int, vocab: int) -> bool:
+        """Trace-time (static-shape) decision.  ``sparse=True`` or
+        ``BIGDL_SPARSE=on`` force it; auto requires the worst-case
+        coalesced row count to be at most half the table — past that
+        the "sparse" sync approaches a dense one and the coalesce is
+        pure overhead (docs/sparse.md "when dense wins")."""
+        if not sparse_enabled():
+            return False
+        if self.sparse is True or _sparse_forced():
+            return True
+        return 2 * n_lookup <= vocab
+
+
+class LookupTable(_EmbeddingBase):
+    """Embedding lookup with optional max-norm renorm and padding row
+    (``nn/LookupTable.scala``).  Index gather is TPU-friendly (no scatter
+    in forward); the backward scatter is either XLA's dense ``[vocab,
+    dim]`` problem or — under a TrainStep sparse capture — the row-sparse
+    ``(indices, rows)`` cotangent this module's family exists for.
+
+    ``padding_idx``: that row receives zero gradient (torch semantics;
+    its value is still gathered).  ``sparse``: None = auto (on when the
+    batch's worst-case touched rows are at most half the vocab), True =
+    force, False = never.  ``max_norm`` renorm keeps the table on the
+    dense path — the renorm Jacobian is part of the dense cotangent and
+    the sparse path will not silently drop it."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0.0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, w_regularizer=None,
+                 one_based: bool = False, padding_idx: Optional[int] = None,
+                 sparse: Optional[bool] = None):
+        super().__init__(n_index, n_output, padding_idx=padding_idx,
+                         sparse=sparse, one_based=one_based)
+        self.padding_value = padding_value
+        self.max_norm, self.norm_type = max_norm, norm_type
+        self.w_regularizer = w_regularizer
+
+    def _sparse_active(self, n_lookup: int, vocab: int) -> bool:
+        if self.max_norm != float("inf"):
+            return False  # renorm Jacobian lives on the dense path only
+        return super()._sparse_active(n_lookup, vocab)
+
+    def update_output(self, input):
+        idx = self._indices(input)
+        w = self.weight
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1,
+                                    keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.clip(norms, 1e-12))
+        return _gather_rows(self, w, idx, self.padding_idx)
+
+
+class EmbeddingBag(_EmbeddingBase):
+    """Per-sample bag of lookups reduced to one vector — the recsys
+    feature shape (a user's N clicked categories -> one embedding):
+    ``[batch, bag]`` indices -> gather -> sum/mean over the bag ->
+    ``[batch, dim]``.  ``padding_idx`` entries contribute nothing: their
+    value is masked out of the reduction and (mean mode) excluded from
+    the denominator, so ragged bags ride fixed shapes.
+
+    The fused form never materializes per-position gradients the way a
+    LookupTable + Sum stack would at ``[batch, bag, dim]`` cotangent
+    granularity — under a sparse capture the cotangent is the coalesced
+    ``(indices, rows)`` of the whole bag batch."""
+
+    MODES = ("sum", "mean")
+
+    def __init__(self, n_index: int, n_output: int, mode: str = "sum",
+                 padding_idx: Optional[int] = None,
+                 sparse: Optional[bool] = None, one_based: bool = False):
+        if mode not in self.MODES:
+            raise ValueError(f"unknown EmbeddingBag mode {mode!r} "
+                             f"(sum | mean)")
+        super().__init__(n_index, n_output, padding_idx=padding_idx,
+                         sparse=sparse, one_based=one_based)
+        self.mode = mode
+
+    def update_output(self, input):
+        idx = self._indices(input)
+        if idx.ndim == 1:
+            idx = idx[:, None]
+        emb = _gather_rows(self, self.weight, idx, self.padding_idx)
+        if self.padding_idx is not None:
+            valid = (idx != self.padding_idx)
+            emb = emb * valid[..., None].astype(emb.dtype)
+            out = jnp.sum(emb, axis=-2)
+            if self.mode == "mean":
+                n = jnp.maximum(jnp.sum(valid, axis=-1, keepdims=True), 1)
+                out = out / n.astype(out.dtype)
+            return out
+        out = jnp.sum(emb, axis=-2)
+        if self.mode == "mean":
+            out = out / jnp.asarray(idx.shape[-1], out.dtype)
+        return out
+
+
+def row_sharding_rules(model: Module, axis: str = "data",
+                       chain=None):
+    """``TrainStep extra_sharding_rules`` mapping every sparse-capable
+    table of ``model`` onto a row-sharded ``PartitionSpec((axis,
+    None))`` — each device holds ``vocab/N`` rows, the forward gather
+    partitions into masked-local lookups, and the sparse update's row
+    scatter lands only on the owning shard (docs/sparse.md
+    "Row-sharded tables").  ``chain``: an existing rules callable
+    consulted first (explicit TP rules win)."""
+    paths = frozenset(sparse_tables(model))
+
+    def rules(path, arr):
+        if chain is not None:
+            spec = chain(path, arr)
+            if spec is not None:
+                return spec
+        if path in paths and getattr(arr, "ndim", 0) == 2:
+            from jax.sharding import PartitionSpec as P
+
+            return P(axis, None)
+        return None
+
+    return rules
+
+
+def sparse_sync_stats(metas: Dict[str, Dict[str, Any]],
+                      itemsize: int = 4) -> Dict[str, Any]:
+    """Static per-step sync accounting from a trace's capture metas: per
+    table, the bytes a dense all-reduce would move (the full ``[vocab,
+    dim]`` gradient) vs what the sparse path syncs (the coalesced rows +
+    their int32 indices).  These are static caps — the per-batch unique
+    count is at most ``slots`` — and the numbers the ``train/sparse``
+    instant and ``tpu_watch`` print."""
+    tables: Dict[str, Dict[str, Any]] = {}
+    for meta in metas.values():
+        row = tables.setdefault(meta["path"], {
+            "path": meta["path"], "vocab": meta["vocab"],
+            "dim": meta["dim"], "touched_rows": 0, "calls": 0,
+            "dense_bytes": meta["vocab"] * meta["dim"] * itemsize})
+        row["touched_rows"] += meta["slots"]
+        row["calls"] += 1
+    for row in tables.values():
+        row["sync_bytes"] = row["touched_rows"] * (row["dim"] * itemsize + 4)
+        row["saved_bytes"] = max(0, row["dense_bytes"] - row["sync_bytes"])
+    rows = sorted(tables.values(), key=lambda r: -r["saved_bytes"])
+    return {"tables": len(rows),
+            "touched_rows": sum(r["touched_rows"] for r in rows),
+            "sync_bytes": sum(r["sync_bytes"] for r in rows),
+            "dense_bytes": sum(r["dense_bytes"] for r in rows),
+            "saved_bytes": sum(r["saved_bytes"] for r in rows),
+            "rows": rows}

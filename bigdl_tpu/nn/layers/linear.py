@@ -320,40 +320,10 @@ class PairwiseDistance(Module):
         return d[0] if squeeze else d
 
 
-class LookupTable(Module):
-    """Embedding lookup with optional max-norm renorm and padding row
-    (``nn/LookupTable.scala``). Index gather is TPU-friendly (no scatter in
-    forward; the backward scatter-add is XLA's problem)."""
-
-    def __init__(self, n_index: int, n_output: int, padding_value: float = 0.0,
-                 max_norm: float = float("inf"), norm_type: float = 2.0,
-                 should_scale_grad_by_freq: bool = False, w_regularizer=None,
-                 one_based: bool = False):
-        super().__init__()
-        self.n_index, self.n_output = n_index, n_output
-        self.padding_value = padding_value
-        self.max_norm, self.norm_type = max_norm, norm_type
-        self.w_regularizer = w_regularizer
-        self.one_based = one_based
-        from bigdl_tpu.nn.init import RandomNormal
-
-        self.weight_init = RandomNormal(0.0, 1.0)
-        self.weight = Parameter(self.weight_init.init((n_index, n_output)))
-
-    def reset(self):
-        self.weight = self.weight_init.init((self.n_index, self.n_output))
-
-    def update_output(self, input):
-        idx = jnp.asarray(input)
-        if idx.dtype in (jnp.float32, jnp.float64, jnp.bfloat16):
-            idx = idx.astype(jnp.int32)
-        if self.one_based:
-            idx = idx - 1
-        w = self.weight
-        if self.max_norm != float("inf"):
-            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
-            w = w * jnp.minimum(1.0, self.max_norm / jnp.clip(norms, 1e-12))
-        return w[idx]
+# LookupTable moved to nn/layers/embedding.py (the sparse-gradient
+# fast-path family, ISSUE 15); re-exported here so `from ...linear
+# import LookupTable` keeps working
+from bigdl_tpu.nn.layers.embedding import LookupTable  # noqa: E402,F401
 
 
 class MixtureTable(Module):

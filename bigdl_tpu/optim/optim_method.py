@@ -50,6 +50,92 @@ class OptimMethod:
     def update(self, grads: Pytree, params: Pytree, state: Pytree) -> Tuple[Pytree, Pytree]:
         raise NotImplementedError
 
+    # -- sparse (row-sparse embedding-gradient) leg ------------------------
+    # docs/sparse.md: a sparse-marked table's gradient arrives as
+    # unique-coalesced ``(indices [C], rows [C, dim])`` pairs instead of
+    # the dense ``[vocab, dim]`` scatter.  ``update_mixed`` merges them
+    # into one update: methods with an exact lazy row-wise apply
+    # (_apply_sparse) touch only the synced rows of the table and its
+    # moments; everything else scatter-adds the rows into a dense
+    # gradient LOCALLY (zero collectives — the sync already happened on
+    # the rows) and defers to the method's own update().  Both legs are
+    # numerics-exact vs the dense path.
+    def _apply_sparse(self, idx, rows, param, state: Pytree, path: str,
+                      scatter=None):
+        """Exact lazy row-wise update of one table; returns
+        ``(new_param, {state_key: new_moment_array})`` or None when this
+        method has no exact lazy form (the caller densifies locally).
+        ``state`` is the PRE-update state (counters not yet advanced);
+        ``idx`` is unique-coalesced with out-of-range fill slots whose
+        ``rows`` are zero — every scatter uses ``mode='drop'``.
+        ``scatter`` (mesh runs) is the caller's partitioning-pinned row
+        scatter (``TrainStep._row_scatter``): GSPMD left alone re-tiles
+        the coalesced updates along the slots axis and lowers the row
+        scatter as partial-scatter + a dense ``[vocab, dim]``
+        all-reduce — exactly the collective this path exists to avoid."""
+        return None
+
+    @staticmethod
+    def _scatter(scatter, target, idx, updates, op: str, kind: str,
+                 path: str):
+        """Row scatter through the caller's pinned implementation when
+        given (``kind`` = 'param' | 'moment' names whose layout rules
+        the target follows), else the plain XLA one."""
+        if scatter is not None:
+            return scatter(target, idx, updates, op, kind, path)
+        if op == "set":
+            return target.at[idx].set(updates, mode="drop")
+        return target.at[idx].add(updates, mode="drop")
+
+    @staticmethod
+    def densify_rows(idx, rows, param):
+        """The exact local fallback: scatter the coalesced rows into a
+        zero table.  A gather's dense cotangent built once, locally —
+        no collective rides it."""
+        return jnp.zeros_like(param).at[idx].add(
+            rows.astype(param.dtype), mode="drop")
+
+    @staticmethod
+    def _state_view(state: Pytree, keys) -> Pytree:
+        """State with per-param moment dicts filtered to ``keys``
+        (scalars pass through untouched)."""
+        keys = set(keys)
+        return {k: ({p: a for p, a in v.items() if p in keys}
+                    if isinstance(v, dict) else v)
+                for k, v in state.items()}
+
+    def update_mixed(self, grads: Pytree, sparse, params: Pytree,
+                     state: Pytree, scatter=None) -> Tuple[Pytree, Pytree]:
+        """One optimizer step over dense grads (``grads``: path -> array,
+        sparse paths absent) plus row-sparse grads (``sparse``: path ->
+        ``(indices, rows)``).  Counters (neval/epoch) advance exactly
+        once.  ``scatter`` see :meth:`_apply_sparse`."""
+        if not sparse:
+            return self.update(grads, params, state)
+        lazy: Dict[str, Tuple[Any, Dict[str, Any]]] = {}
+        densified: Dict[str, Any] = {}
+        for path, (idx, rows) in sparse.items():
+            res = self._apply_sparse(idx, rows, params[path], state, path,
+                                     scatter=scatter)
+            if res is None:
+                densified[path] = self._scatter(
+                    scatter, jnp.zeros_like(params[path]), idx,
+                    rows.astype(params[path].dtype), "add", "param", path)
+            else:
+                lazy[path] = res
+        dense_grads = {**grads, **densified}
+        dparams = {k: params[k] for k in dense_grads}
+        new_dp, new_state = self.update(dense_grads, dparams,
+                                        self._state_view(state, dense_grads))
+        new_params = dict(new_dp)
+        for path, (new_p, moments) in lazy.items():
+            new_params[path] = new_p
+            for skey, arr in moments.items():
+                merged = dict(new_state.get(skey) or {})
+                merged[path] = arr
+                new_state[skey] = merged
+        return new_params, new_state
+
     # -- imperative parity shell ------------------------------------------
     def optimize(self, feval: Callable, parameter):
         """feval(x) -> (loss, grad); updates ``parameter`` in the reference
@@ -344,6 +430,46 @@ class SGD(OptimMethod):
         new_state["neval"] = state["neval"] + 1
         return new_p, new_state
 
+    def _apply_sparse(self, idx, rows, param, state, path, scatter=None):
+        """Exact lazy SGD for a row-sparse table gradient.
+
+        momentum = 0: pure row-wise ``p[u] -= lr * g`` — untouched rows
+        are bit-identical to the dense path's ``p - lr * 0``.
+        momentum > 0: the velocity decay ``mu * v`` is a LOCAL dense
+        elementwise pass (every row's velocity decays, exactly as the
+        dense path does — memory traffic, zero collectives) and the
+        gradient lands row-wise on top, so multi-step numerics match the
+        dense path exactly, including the first-step copy-the-raw-
+        gradient semantic.  Weight decay densifies the gradient
+        semantically (every row moves), so it falls back to the local
+        densify path (return None)."""
+        if self.weight_decay != 0:
+            return None
+        lr = self.schedule.rate(self.learning_rate, state)
+        rows = rows.astype(param.dtype)
+        moments = {}
+        if self.momentum > 0:
+            vel = state["velocity"][path]
+            first = state["neval"] == 0
+            decay = jnp.where(first, 0.0, self.momentum).astype(vel.dtype)
+            damp = jnp.where(first, 0.0, self.dampening)
+            vel = decay * vel
+            vel = self._scatter(scatter, vel, idx,
+                                (1.0 - damp).astype(vel.dtype) * rows,
+                                "add", "moment", path)
+            moments["velocity"] = vel
+            if self.nesterov:
+                step = self.momentum * vel
+                step = self._scatter(scatter, step, idx, rows, "add",
+                                     "moment", path)
+            else:
+                step = vel
+            new_p = param - lr * step
+        else:
+            new_p = self._scatter(scatter, param, idx, -(lr * rows),
+                                  "add", "param", path)
+        return new_p, moments
+
 
 class Adam(OptimMethod):
     """(``optim/Adam.scala``)."""
@@ -424,6 +550,32 @@ class Adagrad(OptimMethod):
         new_p = _tree_map(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
                           params, grads, accum)
         return new_p, {**state, "accum": accum, "neval": state["neval"] + 1}
+
+    def _apply_sparse(self, idx, rows, param, state, path, scatter=None):
+        """Exact lazy Adagrad: an untouched row's dense update is
+        ``accum += 0`` and ``p -= lr * 0 / ...`` — the identity — so
+        touching only the synced rows IS the dense semantics.  The
+        coalesce matters here: duplicate indices arrive pre-summed, so
+        ``accum[r] += (sum of duplicates)^2`` exactly as the dense
+        scatter-then-square would compute it.  Weight decay adds
+        ``wd * p`` to every row's gradient, so it densifies (locally)
+        instead."""
+        if self.weight_decay != 0:
+            return None
+        lr = self.learning_rate / (
+            1.0 + state["neval"].astype(jnp.float32)
+            * self.learning_rate_decay)
+        rows = rows.astype(param.dtype)
+        acc = state["accum"][path]
+        safe = jnp.clip(idx, 0, param.shape[0] - 1)
+        a_rows = acc[safe] + rows * rows  # fill slots: rows == 0 -> no-op
+        new_acc = self._scatter(scatter, acc, idx, a_rows, "set",
+                                "moment", path)
+        new_p = self._scatter(
+            scatter, param, idx,
+            -(lr * rows / (jnp.sqrt(a_rows) + 1e-10)), "add", "param",
+            path)
+        return new_p, {"accum": new_acc}
 
 
 class Adadelta(OptimMethod):

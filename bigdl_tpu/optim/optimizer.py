@@ -1210,6 +1210,9 @@ class Optimizer:
             health_probe=health is not None,
             skip_nonfinite=health is not None and health.skip_nonfinite,
             grad_fault=fault_plan.has("nan_grads"))
+        # exposed for tests/tools that need the compiled-step view of
+        # the run just performed (e.g. sparse-sync engagement evidence)
+        self.last_train_step = step
         # resume functional optimizer state if the method carries it
         if "func_state" in self.optim_method.state:
             restored = jax.tree.map(np.asarray, self.optim_method.state["func_state"])

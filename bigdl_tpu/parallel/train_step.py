@@ -177,6 +177,24 @@ class TrainStep:
         self.buffers = state_dict(model, kind="buffer")
         self.opt_state = optim_method.init_state(self.params)
         self._meta = _param_meta(model)
+        # sparse embedding-gradient sync (docs/sparse.md): the tables
+        # whose gradient may arrive as unique-coalesced (indices, rows)
+        # pairs instead of a dense [vocab, dim] scatter + all-reduce.
+        # Exactness guardrails applied HERE (the layer owns the
+        # per-trace density decision): a regularized table's reg
+        # gradient is dense by definition, and value-clipping with a
+        # bound that moves zeros (lo > 0 or hi < 0) would update every
+        # untouched row on the dense path — both stay dense.
+        from bigdl_tpu.nn.layers import embedding as _embed
+
+        self._sparse_tables = {
+            p: m for p, m in _embed.sparse_tables(model).items()
+            if self._meta.get(p, (1.0, False, None))[2] is None}
+        if self.gradient_clipping is not None and self._sparse_tables:
+            lo, hi = self.gradient_clipping
+            if not (lo <= 0.0 <= hi):
+                self._sparse_tables = {}
+        self._sparse_stats = None
         self._compiled = None
         self._scan_cache = None
         self._place_initial()
@@ -279,20 +297,40 @@ class TrainStep:
         mesh = self.mesh
         skip_nonfinite = self.skip_nonfinite
 
-        def loss_fn(params, buffers, x, y, key):
+        from bigdl_tpu.nn.layers import embedding as _embed
+
+        sparse_tables = self._sparse_tables
+        cap_paths = {id(m): p for p, m in sparse_tables.items()}
+
+        def loss_fn(params, buffers, x, y, key, proxies=None):
             call_params = params
             if cdt is not None:
                 call_params = {k: v.astype(cdt) for k, v in params.items()}
                 x = jax.tree.map(lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a, x)
-            out, new_state = functional_call(
-                model, {**call_params, **buffers}, x, training=True, rng=key)
+            if proxies is None:
+                out, new_state = functional_call(
+                    model, {**call_params, **buffers}, x, training=True,
+                    rng=key)
+                sparse_aux = {}
+            else:
+                # sparse capture: active embedding layers fetch their
+                # cotangent proxies and record their coalesced unique
+                # indices, returned as aux so the update can scatter-add
+                with _embed.SparseCapture(cap_paths, proxies) as cap:
+                    out, new_state = functional_call(
+                        model, {**call_params, **buffers}, x,
+                        training=True, rng=key)
+                # arrays ONLY (jax.checkpoint rejects static leaves in
+                # traced outputs): the static facts (path/slots/vocab)
+                # come from the discovery pass's metas
+                sparse_aux = {k: v["u"] for k, v in cap.aux.items()}
             loss = criterion.update_output(out, y)
             reg_loss = 0.0
             for path, (_, frozen, reg) in meta.items():
                 if reg is not None and not frozen:
                     reg_loss = reg_loss + reg.loss(params[path])
             new_buffers = {k: new_state[k] for k in buffers}
-            return loss + reg_loss, (loss, new_buffers, out)
+            return loss + reg_loss, (loss, new_buffers, out, sparse_aux)
 
         if self.remat:
             # whole-model rematerialization: the backward recomputes the
@@ -308,15 +346,88 @@ class TrainStep:
                 x = jax.tree.map(
                     lambda a: jax.lax.with_sharding_constraint(
                         a, jax.sharding.NamedSharding(mesh, P(ax, *([None] * (a.ndim - 1))))), x)
-            grads, (loss, new_buffers, _) = jax.grad(loss_fn, has_aux=True)(
-                params, buffers, x, y, key)
+            proxies, metas = {}, {}
+            if sparse_tables and _embed.sparse_enabled():
+                # discovery (one eval_shape, no FLOPs): which tables go
+                # sparse for THIS batch shape, and their proxy shapes —
+                # the layer's density rule decides per trace, so a
+                # long-sequence batch over a small vocab stays dense
+                # loss_fn is called WITHOUT proxies here: the discover
+                # capture discover_proxies sets is ambient, so the
+                # layers request shapes from it instead of binding
+                shapes, metas = _embed.discover_proxies(
+                    lambda: loss_fn(params, buffers, x, y, key),
+                    cap_paths)
+                proxies = {k: jnp.zeros(s.shape, s.dtype)
+                           for k, s in shapes.items()}
+            if proxies:
+                active_tables = {m["path"] for m in metas.values()}
+                dense_view = {k: v for k, v in params.items()
+                              if k not in active_tables}
+
+                def inner(dp, pr):
+                    # active tables ride the closure (non-differentiated
+                    # — their gradient IS the proxies'); everything else
+                    # differentiates as before
+                    full = dict(params)
+                    full.update(dp)
+                    return loss_fn(full, buffers, x, y, key, pr)
+
+                (grads, prox_grads), (loss, new_buffers, _, aux) = \
+                    jax.grad(inner, argnums=(0, 1), has_aux=True)(
+                        dense_view, proxies)
+            else:
+                grads, (loss, new_buffers, _, aux) = jax.grad(
+                    loss_fn, has_aux=True)(params, buffers, x, y, key)
+                prox_grads = {}
             if grad_scale is not None:
                 # fault injection BEFORE scaling/clipping/compression:
                 # the probe must see nonfinite GRADS, exactly as a real
                 # divergence would present
                 grads = {k: g * grad_scale for k, g in grads.items()}
+                prox_grads = {k: g * grad_scale
+                              for k, g in prox_grads.items()}
             if cdt is not None:
                 grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
+                prox_grads = {k: g.astype(jnp.float32)
+                              for k, g in prox_grads.items()}
+            def replicate_pair(u, g):
+                # pin the sync collective onto the SMALL arrays: the
+                # partitioner must replicate the coalesced rows (an
+                # all-reduce over [slots, dim]) before any scatter —
+                # never partial-scatter into [vocab, dim] and
+                # all-reduce that
+                if mesh is None:
+                    return u, g
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                rep = NamedSharding(mesh, P())
+                return (jax.lax.with_sharding_constraint(u, rep),
+                        jax.lax.with_sharding_constraint(g, rep))
+
+            # group the proxy cotangents by table; a table used MORE
+            # THAN ONCE per forward densifies LOCALLY here, BEFORE the
+            # nonlinear grad legs (bf16 truncate / value clip / global
+            # norm): those must see the cross-call SUM exactly as the
+            # dense path does, and the lazy Adagrad sum-then-square
+            # also requires pre-summed rows.  Single-call tables (the
+            # norm) stay row-sparse through every leg.
+            by_path = {}
+            for pkey, g in prox_grads.items():
+                by_path.setdefault(metas[pkey]["path"], []).append(
+                    (aux[pkey], g))
+            sparse_entries = {}
+            for path, entries in by_path.items():
+                if len(entries) == 1:
+                    sparse_entries[path] = entries[0]
+                else:
+                    dense = jnp.zeros_like(params[path])
+                    for u, g in entries:
+                        u, g = replicate_pair(u, g)
+                        dense = dense.at[u].add(g.astype(dense.dtype),
+                                                mode="drop")
+                    grads[path] = dense  # rides the dense legs below
             # per-layer scales & freeze
             scaled = {}
             for k, g in grads.items():
@@ -326,15 +437,38 @@ class TrainStep:
                 elif scale != 1.0:
                     g = g * scale
                 scaled[k] = g
+            # sparse rows ride the same legs keyed by their table's path
+            for path, (u, g) in list(sparse_entries.items()):
+                scale, frozen, _ = meta[path]
+                if frozen:
+                    g = jnp.zeros_like(g)
+                elif scale != 1.0:
+                    g = g * scale
+                sparse_entries[path] = (u, g)
             if comp == "bf16":
                 scaled = {k: bf16_truncate(v) for k, v in scaled.items()}
+                sparse_entries = {
+                    k: (u, bf16_truncate(g))
+                    for k, (u, g) in sparse_entries.items()}
             if self.gradient_clipping is not None:
                 lo, hi = self.gradient_clipping
                 scaled = {k: jnp.clip(v, lo, hi) for k, v in scaled.items()}
+                # constructor guarantees lo <= 0 <= hi when sparse
+                # tables are live, so untouched (zero) rows stay zero
+                sparse_entries = {
+                    k: (u, jnp.clip(g, lo, hi))
+                    for k, (u, g) in sparse_entries.items()}
             if self.max_norm is not None:
-                gn = jnp.sqrt(sum(jnp.sum(v * v) for v in scaled.values()))
+                gn = jnp.sqrt(sum(jnp.sum(v * v) for v in scaled.values())
+                              + sum(jnp.sum(g * g)
+                                    for _, g in sparse_entries.values()))
                 factor = jnp.minimum(1.0, self.max_norm / (gn + 1e-12))
                 scaled = {k: v * factor for k, v in scaled.items()}
+                sparse_entries = {
+                    k: (u, g * factor)
+                    for k, (u, g) in sparse_entries.items()}
+            sparse_g = {path: replicate_pair(u, g)
+                        for path, (u, g) in sparse_entries.items()}
             # ZeRO-1/3: constrain optimizer state onto the batch axis so
             # XLA lowers the gradient collective to reduce-scatter +
             # all-gather; TP-ruled params' moment buffers follow the TP
@@ -345,7 +479,28 @@ class TrainStep:
                     lambda a, s: jax.lax.with_sharding_constraint(a, s)
                     if hasattr(a, "ndim") else a,
                     opt_state, self._opt_state_shardings(opt_state))
-            new_params, new_opt = optim.update(scaled, params, opt_state)
+            # trace-time bookkeeping for the `train/sparse` instant:
+            # static per-step sync accounting (what a dense all-reduce
+            # of each table would move vs the coalesced rows)
+            if sparse_g:
+                self._sparse_stats = _embed.sparse_sync_stats(
+                    {k: m for k, m in metas.items()
+                     if m["path"] in sparse_g})
+            if sparse_g and hasattr(optim, "update_mixed"):
+                new_params, new_opt = optim.update_mixed(
+                    scaled, sparse_g, params, opt_state,
+                    scatter=self._row_scatter())
+            else:
+                # the pre-sparse contract: a duck-typed method needs
+                # only update().  With sparse grads in hand, densify
+                # them LOCALLY (zero collectives — the sync already
+                # happened on the rows) so such a method still trains
+                # exactly.
+                for path, (u, g) in sparse_g.items():
+                    scaled[path] = jnp.zeros_like(params[path]).at[u].add(
+                        g.astype(params[path].dtype), mode="drop")
+                new_params, new_opt = optim.update(scaled, params,
+                                                   opt_state)
             if mesh is not None:
                 new_params = {
                     k: jax.lax.with_sharding_constraint(v, self._param_sharding(k, v))
@@ -360,6 +515,19 @@ class TrainStep:
                 gsq = psq = usq = jnp.float32(0.0)
                 gbad = pbad = jnp.int32(0)
                 for k, g in scaled.items():
+                    g32 = g.astype(jnp.float32)
+                    p32 = params[k].astype(jnp.float32)
+                    n32 = new_params[k].astype(jnp.float32)
+                    d32 = n32 - p32
+                    gsq += jnp.sum(g32 * g32)
+                    psq += jnp.sum(p32 * p32)
+                    usq += jnp.sum(d32 * d32)
+                    gbad += jnp.sum((~jnp.isfinite(g32)).astype(jnp.int32))
+                    pbad += jnp.sum((~jnp.isfinite(n32)).astype(jnp.int32))
+                for k, (_u, g) in sparse_g.items():
+                    # a row-sparse grad's norm IS the dense grad's norm
+                    # (the zeros contribute nothing); param/update norms
+                    # read the full table like any other param
                     g32 = g.astype(jnp.float32)
                     p32 = params[k].astype(jnp.float32)
                     n32 = new_params[k].astype(jnp.float32)
@@ -388,6 +556,70 @@ class TrainStep:
             return new_params, new_opt, new_buffers, loss
 
         return step
+
+    def _row_scatter(self):
+        """The sparse update's row scatter, pinned against GSPMD's
+        parallel-scatter lowering (docs/sparse.md).
+
+        Left to itself the partitioner re-tiles the (replicated,
+        free-to-slice) coalesced rows along the slots axis and lowers
+        ``table.at[u].add(rows)`` as per-shard partial scatter + a dense
+        ``[vocab, dim]`` all-reduce — re-creating the exact collective
+        the sparse path removes, and sharding constraints on the
+        operands alone do not dissuade it.  So: a REPLICATED target runs
+        the scatter inside ``shard_map`` with fully-replicated specs
+        (per-device identical local code — structurally no collective;
+        the rows' own small all-reduce happens at the replication
+        constraint, which IS the sync).  A dim0-SHARDED target (ZeRO
+        moments, fsdp/row-sharded tables) keeps the GSPMD path with its
+        layout pinned on both sides — each shard masks and applies the
+        rows that land in its range.  Returns None off-mesh (the plain
+        ``.at[]`` scatter is already local)."""
+        mesh = self.mesh
+        if mesh is None or mesh.devices.size <= 1:
+            return None
+        from functools import partial
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        try:  # jax >= 0.6 exports shard_map at top level (check_vma)
+            from jax import shard_map as _sm
+            smap = partial(_sm, check_vma=False)
+        except ImportError:  # this jaxlib (0.4.x): experimental
+            from jax.experimental.shard_map import shard_map as _sm
+            smap = partial(_sm, check_rep=False)
+        rep = NamedSharding(mesh, P())
+
+        def spec_of(kind, path, arr):
+            if kind == "param":
+                sh = self._param_sharding(path, arr)
+                return sh.spec if sh is not None else P()
+            if self.extra_sharding_rules is not None:
+                s = self.extra_sharding_rules(path, arr)
+                if s is not None:
+                    return s
+            sh = self._opt_leaf_sharding(arr)
+            return sh.spec if sh is not None else P()
+
+        def scatter(target, idx, updates, op, kind, path):
+            idx = jax.lax.with_sharding_constraint(idx, rep)
+            updates = jax.lax.with_sharding_constraint(updates, rep)
+            spec = spec_of(kind, path, target)
+
+            def body(t, i, u):
+                if op == "set":
+                    return t.at[i].set(u, mode="drop")
+                return t.at[i].add(u, mode="drop")
+
+            if tuple(spec) == ():
+                return smap(body, mesh=mesh, in_specs=(P(), P(), P()),
+                            out_specs=P())(target, idx, updates)
+            sharding = NamedSharding(mesh, spec)
+            target = jax.lax.with_sharding_constraint(target, sharding)
+            return jax.lax.with_sharding_constraint(
+                body(target, idx, updates), sharding)
+
+        return scatter
 
     def _build(self):
         return jax.jit(self._step_fn(with_health=self.health_probe),
@@ -479,6 +711,7 @@ class TrainStep:
                                   t0, self._compiled)
             if first:
                 self._emit_device_facts(tracer, x, y, key)
+                self._emit_sparse_instant(tracer)
         if _hooks.hooks_active():
             _hooks.cache_event(self, kind,
                                _jit_cache_size(self._compiled))
@@ -623,6 +856,18 @@ class TrainStep:
                                               / hit["limit_bytes"]
                                               * 100.0, 2))
 
+    def _emit_sparse_instant(self, tracer) -> None:
+        """Once per step object: the sparse-sync accounting recorded at
+        trace time (docs/sparse.md) — per-table touched-row caps, the
+        bytes the coalesced sync moves, and what the dense table
+        all-reduce would have moved."""
+        stats = self._sparse_stats
+        if not stats:
+            return
+        st = dict(stats)
+        st["rows"] = list(st.get("rows") or [])[:8]
+        tracer.instant("train/sparse", **st)
+
     def _shard_batch(self, x, y, stacked: bool = False):
         if self.mesh is None:
             return jax.tree.map(jnp.asarray, x), jax.tree.map(jnp.asarray, y)
@@ -739,6 +984,7 @@ class TrainStep:
         if tracer is not None:
             tracer.emit("compile", name="TrainStep.aot_scan",
                         dur=time.perf_counter() - t0, iters=n)
+            self._emit_sparse_instant(tracer)
             from bigdl_tpu.telemetry import device as _tdev
             from bigdl_tpu.utils.config import get_config
 

@@ -96,6 +96,12 @@ def attribute_main(argv) -> int:
                    choices=("allreduce", "sharded", "fsdp"),
                    help="(--comms/--memory --model) parameter_sync "
                         "mode to compile with")
+    p.add_argument("--sparse", default=None,
+                   choices=("off", "auto", "on"),
+                   help="(--comms --model) override BIGDL_SPARSE for "
+                        "this compile — A/B the sparse embedding sync "
+                        "vs the dense table all-reduce "
+                        "(docs/sparse.md)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
     if (args.run is None) == (args.model is None):
@@ -130,7 +136,7 @@ def attribute_main(argv) -> int:
         if args.model is not None:
             result = comms_mod.attribute_comms_model(
                 args.model, batch=args.batch, devices=args.mesh,
-                sync=args.sync)
+                sync=args.sync, sparse=args.sparse)
         else:
             events, parse_errors = schema.read_events(args.run)
             for e in parse_errors:

@@ -329,33 +329,50 @@ def attribute_comms_train_step(step, x, y, key=None) -> Dict[str, Any]:
 
 
 def attribute_comms_model(name: str, batch: int = 8, devices: int = 0,
-                          sync: str = "allreduce") -> Dict[str, Any]:
+                          sync: str = "allreduce",
+                          sparse: Optional[str] = None) -> Dict[str, Any]:
     """Registry-model comms attribution over a fresh ``data``-axis mesh
     spanning ``devices`` devices (0 = all local devices) — CPU-friendly:
-    one local XLA compile, no run needed."""
+    one local XLA compile, no run needed.  ``sparse`` overrides the
+    ``BIGDL_SPARSE`` mode for this compile (off | auto | on) — the A/B
+    that shows an embedding table's sync bytes collapsing to the
+    touched-rows fraction (docs/sparse.md)."""
+    import dataclasses
+
     import jax
 
     import bigdl_tpu.optim as optim
     from bigdl_tpu.models import registry
     from bigdl_tpu.parallel.mesh import DATA_AXIS, make_mesh
     from bigdl_tpu.parallel.train_step import TrainStep
+    from bigdl_tpu.utils.config import get_config, set_config
 
-    n = devices or len(jax.devices())
-    mesh = make_mesh((n,), (DATA_AXIS,), devices=jax.devices()[:n])
-    model = registry.build_model(name)
-    spec = registry.input_spec(name, batch)
-    pieces = registry.train_pieces(name, batch)
-    if pieces is None:
-        raise ValueError(f"registry model {name!r} has no training "
-                         f"pieces — comms attribution needs a train step")
-    criterion, target_spec = pieces
-    step = TrainStep(model, criterion,
-                     optim.SGD(learning_rate=0.01, momentum=0.9),
-                     mesh=mesh, parameter_sync=sync)
-    out = attribute_comms_train_step(step, spec, target_spec)
+    prev = get_config()
+    if sparse is not None:
+        set_config(dataclasses.replace(prev, sparse_sync=sparse))
+    try:
+        n = devices or len(jax.devices())
+        mesh = make_mesh((n,), (DATA_AXIS,), devices=jax.devices()[:n])
+        model = registry.build_model(name)
+        spec = registry.input_spec(name, batch)
+        pieces = registry.train_pieces(name, batch)
+        if pieces is None:
+            raise ValueError(f"registry model {name!r} has no training "
+                             f"pieces — comms attribution needs a train "
+                             f"step")
+        criterion, target_spec = pieces
+        step = TrainStep(model, criterion,
+                         optim.SGD(learning_rate=0.01, momentum=0.9),
+                         mesh=mesh, parameter_sync=sync)
+        out = attribute_comms_train_step(step, spec, target_spec)
+    finally:
+        if sparse is not None:
+            set_config(prev)
     out["model"] = name
     out["batch"] = batch
     out["mesh"] = {"devices": n, "sync": sync}
+    if sparse is not None:
+        out["sparse"] = sparse
     return out
 
 

@@ -106,6 +106,10 @@ class MetricsSink:
         # telemetry/memory.py): the latest compiled-peak + live
         # allocator snapshot — tpu_watch's hbm= block
         self.last_memory: Dict[str, Any] = {}
+        # sparse embedding sync accounting (train/sparse instant,
+        # docs/sparse.md): the latest static per-step caps —
+        # tpu_watch's sparse= block
+        self.sparse: Dict[str, Any] = {}
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -153,6 +157,13 @@ class MetricsSink:
                     self.cache_hits += 1
                 elif name == "compile/cache_miss":
                     self.cache_misses += 1
+                elif name == "train/sparse":
+                    # sparse embedding sync accounting (docs/sparse.md):
+                    # static per-step caps — what tpu_watch prints
+                    self.sparse = {k: event[k] for k in
+                                   ("tables", "touched_rows",
+                                    "sync_bytes", "dense_bytes",
+                                    "saved_bytes") if k in event}
             elif kind == "compile":
                 self.compiles += 1
                 self.compile_s += float(event.get("dur", 0.0))
@@ -236,7 +247,8 @@ class MetricsSink:
                         "slo_violations": self.requests.slo_violations,
                         "slowest": dict(self.requests.slowest)},
                     "comms": dict(self.last_comms),
-                    "memory": dict(self.last_memory)}
+                    "memory": dict(self.last_memory),
+                    "sparse": dict(self.sparse)}
 
     def openmetrics(self) -> str:
         """Prometheus/OpenMetrics exposition text of the current state."""

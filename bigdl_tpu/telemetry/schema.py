@@ -150,6 +150,13 @@ STREAM_NAMES = frozenset({
     # HBM limit — the step before RESOURCE_EXHAUSTED, surfaced so the
     # fleet blame and tpu_watch can call it BEFORE the crash
     "memory/pressure",
+    # sparse embedding-gradient sync (nn/layers/embedding.py +
+    # parallel/train_step.py, docs/sparse.md): once per step object,
+    # the static per-step sync accounting — touched-row caps per table,
+    # bytes the coalesced (indices, rows) sync moves, and the dense
+    # table all-reduce bytes it replaced (saved_bytes = the win
+    # tpu_watch prints and the comms walker confirms)
+    "train/sparse",
     # health findings (telemetry/health.py detectors + policy)
     "health/nonfinite", "health/skip", "health/loss_spike",
     "health/plateau", "health/grad_explosion", "health/halt",

@@ -50,6 +50,7 @@ test (or an embedding application) can inject overrides with
 | cluster_deadline       | BIGDL_CLUSTER_DEADLINE      | peer-heartbeat deadline seconds (0 = derive from the straggler budget, else 120s) |
 | heartbeat_interval     | BIGDL_HEARTBEAT_INTERVAL    | heartbeat publish/poll throttle seconds (default 1.0) |
 | scan_layers            | BIGDL_SCAN_LAYERS           | build registry models with repeated blocks stacked into ScanLayers (docs/compile.md; default off) |
+| sparse_sync            | BIGDL_SPARSE                | sparse embedding-gradient sync (docs/sparse.md): off / auto (on when touched rows <= vocab/2) / on — numerics-exact row-sparse (indices, rows) sync instead of the dense table all-reduce |
 | trace_requests         | BIGDL_TRACE                 | per-request serving traces (telemetry/request_trace.py): span timelines, /v1/trace/<id>, blame verdicts (default on; off disables recording) |
 | trace_ring             | BIGDL_TRACE_RING            | recent-trace ring size per server (default 512) |
 | trace_slowest          | BIGDL_TRACE_SLOWEST         | always-kept slowest-k traces per endpoint — the p99 exemplars eviction can never touch (default 8) |
@@ -179,6 +180,13 @@ class BigDLConfig:
     # registry models with repeated-block runs stacked into ScanLayers
     # so XLA compiles ONE block body instead of N
     scan_layers: bool = False
+    # sparse embedding-gradient sync (nn/layers/embedding.py,
+    # docs/sparse.md): off | auto | on.  auto (default) routes a
+    # sparse-capable table through the row-sparse (indices, rows)
+    # cotangent when the batch's worst-case touched rows are at most
+    # half the vocab; on forces every capable table; off is the dense
+    # A/B baseline.  Numerics-exact either way.
+    sparse_sync: str = "auto"
     # request-level serving traces (telemetry/request_trace.py,
     # docs/observability.md "Tracing a request"): recording on/off,
     # recent-ring size, pinned slowest-k per endpoint, per-trace span cap
@@ -247,6 +255,8 @@ class BigDLConfig:
             cluster_deadline=_float("BIGDL_CLUSTER_DEADLINE", 0.0),
             heartbeat_interval=_float("BIGDL_HEARTBEAT_INTERVAL", 1.0),
             scan_layers=_truthy(env.get("BIGDL_SCAN_LAYERS")),
+            sparse_sync=(env.get("BIGDL_SPARSE")
+                         or "auto").strip().lower(),
             trace_requests=(env.get("BIGDL_TRACE") or "on").strip().lower()
             not in ("0", "off", "false", "no"),
             trace_ring=_int("BIGDL_TRACE_RING", 512),

@@ -74,10 +74,24 @@ def main():
         telemetry.start_run(os.environ["BIGDL_TELEMETRY"])
 
     RNG.set_seed(7)
-    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
-                          nn.Linear(16, 4), nn.LogSoftMax())
     rng = np.random.RandomState(0)
-    x = rng.randn(64, 8).astype(np.float32)
+    if os.environ.get("BIGDL_TEST_SPARSE"):
+        # sparse embedding-sync equivalence (tests/test_sparse.py's
+        # acceptance, across a real process boundary): an embedding
+        # classifier whose per-step lookups (16 rows x 6 tokens = 96)
+        # sit under half the 256-row vocab, so the auto rule engages
+        # the row-sparse (indices, rows) sync — incl. duplicate indices
+        # and the padding index in every batch
+        model = nn.Sequential(nn.LookupTable(256, 8, padding_idx=0),
+                              nn.Select(1, -1), nn.Linear(8, 4),
+                              nn.LogSoftMax())
+        x = rng.randint(0, 256, (64, 6)).astype(np.int32)
+        x[:, 0] = x[:, 1]  # duplicates in every row
+        x[0, 2] = 0        # the padding index
+    else:
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                              nn.Linear(16, 4), nn.LogSoftMax())
+        x = rng.randn(64, 8).astype(np.float32)
     y = rng.randint(0, 4, 64)
     samples = [Sample(x[i], y[i]) for i in range(64)]
 
@@ -130,6 +144,14 @@ def main():
 
         o.dataset._transformers.append(_slow)
     trained = o.optimize()
+
+    if os.environ.get("BIGDL_TEST_SPARSE") and \
+            os.environ.get("BIGDL_SPARSE", "auto") != "off":
+        # the equivalence claim is vacuous if the sparse path silently
+        # stayed dense — require the engagement evidence
+        stats = getattr(o.last_train_step, "_sparse_stats", None)
+        assert stats and stats["tables"] == 1, (
+            f"sparse sync did not engage: {stats}")
 
     if fleet_mode:
         import json as _json

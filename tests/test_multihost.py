@@ -111,6 +111,20 @@ def test_four_process_training_matches_single_process(tmp_path):
 
 
 @pytest.mark.deadline(240)
+def test_two_process_sparse_sync_matches_dense_single_process(tmp_path):
+    """The sparse-sync acceptance (ISSUE 15, docs/sparse.md) on the
+    REAL 2-process gloo cluster: the embedding classifier trained under
+    the row-sparse (indices, rows) sync equals the single-process run
+    forced DENSE (``BIGDL_SPARSE=off``) — cross-process sync exactness
+    and sparse-vs-dense numerics in one trajectory, duplicate indices
+    and the padding index included."""
+    mp = _run_cluster(tmp_path, "mp_sparse", BIGDL_TEST_SPARSE=1)
+    sp = _run_single(tmp_path, "sp_sparse", BIGDL_TEST_SPARSE=1,
+                     BIGDL_SPARSE="off")
+    _assert_same_params(mp, sp)
+
+
+@pytest.mark.deadline(240)
 def test_two_process_zero1_matches_single_process(tmp_path):
     """ZeRO-1 optimizer-state sharding across the process boundary."""
     mp = _run_cluster(tmp_path, "mp_z1", BIGDL_TEST_ZERO1=1)

@@ -60,7 +60,13 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           # peak_hbm_bytes diff gate, the fit estimator, and the
           # OOM-forensics evidence — a silent drop reverts device OOMs
           # to a bare RESOURCE_EXHAUSTED
-          "bigdl_tpu/telemetry/memory.py"]
+          "bigdl_tpu/telemetry/memory.py",
+          # sparse embedding fast path (ISSUE 15): the row-sparse
+          # cotangent capture + the recsys scenario — a silent drop
+          # reverts every embedding gradient to the dense table
+          # all-reduce and loses the dlrm bench/serving tenant
+          "bigdl_tpu/nn/layers/embedding.py",
+          "bigdl_tpu/models/dlrm.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

@@ -87,6 +87,12 @@ PINNED_MODULES = [
     # budget become unmeasured again)
     "bigdl_tpu/nn/layers/scan.py",
     "bigdl_tpu/utils/compile_cache.py",
+    # sparse embedding fast path (ISSUE 15): losing embedding.py
+    # silently reverts every table gradient to the dense [vocab, dim]
+    # all-reduce (and drops LookupTable/EmbeddingBag outright); losing
+    # dlrm.py drops the recsys scenario both bench harnesses gate
+    "bigdl_tpu/nn/layers/embedding.py",
+    "bigdl_tpu/models/dlrm.py",
 ]
 
 

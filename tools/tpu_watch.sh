@@ -168,6 +168,13 @@ comms = st.get("comms") or {}
 if comms.get("bytes"):
     line += (f" comms={comms['bytes'] / 1e6:.1f}MB/step"
              f"@{comms.get('count', '?')}coll")
+# sparse embedding sync (train/sparse instant, docs/sparse.md): the
+# bytes-per-step the row-sparse sync saves vs a dense table all-reduce
+# — a babysitter sees whether the fast path is actually engaged
+sp = st.get("sparse") or {}
+if sp.get("saved_bytes"):
+    line += (f" sparse={sp['saved_bytes'] / 1e6:.1f}MB-saved/step"
+             f"@{sp.get('tables', '?')}tbl")
 # memory attribution (telemetry/memory.py): live allocator vs limit +
 # the compiled step's predicted per-device peak — the babysitter sees a
 # run creeping toward RESOURCE_EXHAUSTED before it dies
