@@ -894,6 +894,19 @@ def _sweep():
         pass
     if infer is not None:
         line["infer_int8_vs_bf16"] = infer
+    try:
+        from bigdl_tpu import telemetry as _tel
+
+        # the run is still open here, so read the live ledger rather
+        # than the (unwritten) run log — diff gates compare goodput_pct
+        # / badput_s across rounds like any other metric
+        gp = _tel.goodput()
+        if gp and gp.get("wall_s"):
+            line["goodput_pct"] = gp["goodput_pct"]
+            line["badput_s"] = gp["badput_s"]
+            line["badput"] = gp["badput"]
+    except Exception:  # noqa: BLE001 — accounting must not sink the sweep
+        pass
     print(json.dumps(line))
     return line
 

@@ -411,6 +411,9 @@ def main(argv=None) -> int:
                 row["slo_violations"] = server.slo.violations
         finally:
             server.stop(drain=True)
+        # read the live goodput ledger while the run is still open —
+        # end_run (the `with` exit) detaches it
+        gp = telemetry.goodput()
     if owned_log:
         print(f"# telemetry run log: {owned_log}", file=sys.stderr)
 
@@ -424,6 +427,9 @@ def main(argv=None) -> int:
         line = {"metric": f"serving_{args.model}_qps",
                 "value": row.get("qps"), "unit": "qps",
                 "vs_baseline": None, "configs": {name: row}}
+    if gp and gp.get("wall_s"):
+        line["goodput_pct"] = gp["goodput_pct"]
+        line["badput_s"] = gp["badput_s"]
     print(json.dumps(line))
     sys.stdout.flush()
 

@@ -451,7 +451,18 @@ def cmd_supervise(args) -> None:
                      keep_faults=args.keep_faults,
                      log_dir=args.log_dir,
                      min_nprocs=args.min_n)
-    raise SystemExit(sup.run())
+    from bigdl_tpu import telemetry
+
+    # the supervisor's own run log is the incarnation-chain spine the
+    # goodput ledger stitches against: cluster/restart (with backoff_s),
+    # cluster/reshard and cluster/drain instants land here instead of
+    # being dropped on the floor (BIGDL_TELEMETRY gates it, as for the
+    # workers — which inherit the same dir through the environment)
+    with telemetry.maybe_run(meta={"cmd": "supervise",
+                                   "role": "supervisor",
+                                   "declared_n": args.nprocs}):
+        rc = sup.run()
+    raise SystemExit(rc)
 
 
 def cmd_summary(args) -> None:

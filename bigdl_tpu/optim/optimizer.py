@@ -639,6 +639,18 @@ class Optimizer:
         return self._restore_from(d)
 
     def _restore_from(self, d: str) -> bool:
+        """Timed wrapper around :meth:`_restore_from_verified`: the
+        restore interval is checkpoint badput the goodput ledger
+        (telemetry/ledger.py) must see as a measured out-of-step
+        interval, not unattributable idle."""
+        t0 = time.perf_counter()
+        try:
+            return self._restore_from_verified(d)
+        finally:
+            telemetry.stage("checkpoint/restore",
+                            time.perf_counter() - t0, source=d)
+
+    def _restore_from_verified(self, d: str) -> bool:
         """Restore the newest VERIFIED checkpoint under ``d``: content
         digests are checked before anything is loaded, torn candidates
         are quarantined (``*.corrupt`` + ``checkpoint/quarantined``)
@@ -933,6 +945,8 @@ class Optimizer:
             log.info(f"[Resume] fast-forwarded {skipped} records in "
                      f"{time.perf_counter() - t0:.2f}s to resume "
                      f"mid-epoch")
+        telemetry.stage("resume/fast_forward",
+                        time.perf_counter() - t0, records=skipped)
         return data_iter
 
     # -- validation --------------------------------------------------------

@@ -732,8 +732,10 @@ class Supervisor:
         # — their SIGTERM/SIGKILL codes are a reaction the supervisor
         # caused, never the casualty that seeded the failure (the
         # --min-n signature must not blame a drained survivor)
-        self._drained_slots.update(
-            i for i, p in enumerate(self._procs) if p.poll() is None)
+        t0 = time.time()
+        draining = [i for i, p in enumerate(self._procs)
+                    if p.poll() is None]
+        self._drained_slots.update(draining)
         self._signal_all(signal.SIGTERM)
         deadline = time.time() + grace
         while any(p.poll() is None for p in self._procs) \
@@ -746,6 +748,13 @@ class Supervisor:
             self._signal_all(signal.SIGKILL)
         for p in self._procs:
             p.wait()
+        from bigdl_tpu import telemetry
+
+        # measured drain interval: the goodput ledger charges it as
+        # `drain` badput rather than unattributable idle
+        telemetry.instant("cluster/drain", dur=time.time() - t0,
+                          grace=grace, procs=len(draining),
+                          killed=len(still))
 
     def _wait_incarnation(self) -> List[int]:
         """Block until the incarnation resolves; returns exit codes.

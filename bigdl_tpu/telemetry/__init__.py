@@ -33,7 +33,7 @@ from bigdl_tpu.telemetry.tracer import (SCHEMA_VERSION, JsonlSink,
 __all__ = ["SCHEMA_VERSION", "Tracer", "JsonlSink", "MemorySink",
            "enabled", "get", "start_run", "end_run", "run", "maybe_run",
            "last_run_path", "metrics_server", "flight_recorder",
-           "fleet_watcher", "span",
+           "fleet_watcher", "goodput", "span",
            "stage", "counter", "gauge", "instant", "emit"]
 
 _active: Optional[Tracer] = None
@@ -41,6 +41,7 @@ _last_run_path: Optional[str] = None
 _metrics_server = None
 _flight = None
 _fleet = None
+_ledger = None
 _lifecycle_lock = threading.Lock()
 
 
@@ -84,8 +85,23 @@ def fleet_watcher():
     return _fleet
 
 
+def goodput() -> Optional[Dict[str, Any]]:
+    """Live goodput/badput decomposition of the active run (the ledger
+    fold every sink shares), or None when no run is active or nothing
+    has been emitted yet.  The same report is written as the run's
+    final ``goodput`` event by :func:`end_run`."""
+    ledger = _ledger
+    return ledger.event_fields() if ledger is not None else None
+
+
 def _default_meta() -> Dict[str, Any]:
     meta: Dict[str, Any] = {"schema": SCHEMA_VERSION}
+    inc = os.environ.get("BIGDL_SUPERVISOR_INCARNATION")
+    if inc is not None:
+        try:  # stitchable chains: which supervisor incarnation is this
+            meta["incarnation"] = int(inc)
+        except ValueError:
+            pass
     try:  # device facts are best-effort: telemetry must work sans jax
         import jax
 
@@ -107,7 +123,8 @@ def start_run(path_or_dir: Optional[str] = None,
     ``run-<stamp>-<pid>.jsonl``; None writes to no file (pass ``sinks``,
     e.g. a MemorySink, instead).  Raises if a run is already active —
     nested runs would interleave two schedules into one file."""
-    global _active, _last_run_path, _metrics_server, _flight, _fleet
+    global _active, _last_run_path, _metrics_server, _flight, _fleet, \
+        _ledger
     with _lifecycle_lock:
         if _active is not None:
             raise RuntimeError("a telemetry run is already active; "
@@ -115,6 +132,13 @@ def start_run(path_or_dir: Optional[str] = None,
         full_meta = _default_meta()
         full_meta.update(meta or {})
         all_sinks = list(sinks or [])
+        try:  # the run-level goodput ledger rides as one more sink
+            from bigdl_tpu.telemetry.ledger import LedgerFold
+
+            _ledger = LedgerFold()
+            all_sinks.append(_ledger)
+        except Exception:  # noqa: BLE001 - observers never kill the run
+            _ledger = None
         run_dir = None
         if path_or_dir is not None:
             path = path_or_dir
@@ -201,7 +225,7 @@ def _maybe_fleet(run_dir, meta):
 def end_run() -> None:
     """Close the active run (flushes and closes sinks, stops the metrics
     endpoint and the fleet watcher); no-op when no run is active."""
-    global _active, _metrics_server, _flight, _fleet
+    global _active, _metrics_server, _flight, _fleet, _ledger
     if _fleet is not None:
         try:
             # one final poll under the still-open tracer so a short
@@ -213,7 +237,17 @@ def end_run() -> None:
         tracer, _active = _active, None
         server, _metrics_server = _metrics_server, None
         watcher, _fleet = _fleet, None
+        ledger, _ledger = _ledger, None
         _flight = None
+    if tracer is not None and ledger is not None:
+        try:
+            # the run's last word: the goodput/badput decomposition of
+            # everything emitted before it (written before run_end)
+            fields = ledger.event_fields()
+            if fields is not None:
+                tracer.emit("goodput", **fields)
+        except Exception:  # noqa: BLE001 - shutdown must never raise
+            pass
     if watcher is not None:
         try:
             watcher.stop()

@@ -17,6 +17,8 @@ Options::
     python -m bigdl_tpu.telemetry trace run.jsonl --id abc123  # waterfalls
     python -m bigdl_tpu.telemetry diff old.jsonl new.jsonl   # regression
     python -m bigdl_tpu.telemetry diff old_bench.json new_bench.json
+    python -m bigdl_tpu.telemetry goodput run.jsonl ...      # wall-time
+    python -m bigdl_tpu.telemetry goodput --supervise-dir d  # ledger
     python -m bigdl_tpu.telemetry attribute --model lenet    # per-module cost
     python -m bigdl_tpu.telemetry attribute run.jsonl        # from a run log
     python -m bigdl_tpu.telemetry attribute --comms --model lenet --mesh 2
@@ -279,12 +281,17 @@ def main(argv=None) -> int:
         from bigdl_tpu.telemetry import request_trace
 
         return request_trace.trace_main(argv[1:])
+    if argv and argv[0] == "goodput":
+        from bigdl_tpu.telemetry import ledger
+
+        return ledger.goodput_main(argv[1:])
 
     p = argparse.ArgumentParser(
         prog="bigdl_tpu.telemetry",
         description="summarize / compare / export telemetry run logs "
                     "(subcommands: diff <runA> <runB>, fleet <dir> "
                     "[--watch], trace run.jsonl [--slowest N|--id ID], "
+                    "goodput <run.jsonl...|--supervise-dir DIR>, "
                     "attribute [run.jsonl | --model NAME] "
                     "[--comms|--memory], memory --model NAME --mesh N)")
     p.add_argument("runs", nargs="+", metavar="run.jsonl",

@@ -17,7 +17,16 @@ Mapping (one lane per pid/tid, as the tracer emitted them):
   one NAMED LANE per request (synthetic tid from the trace id, labelled
   ``req <id> [endpoint]``) holding the span waterfall as complete
   events plus per-token instants — the per-request timeline view of a
-  serving run.
+  serving run;
+- badput (telemetry/ledger.py taxonomy) -> one synthetic
+  ``badput:<category>`` lane per process per category: compile /
+  data_wait / checkpoint / replay / retry_backoff / drain / straggler
+  slices are re-rendered as ``X`` events on their own lane so the
+  goodput decomposition is visible on the timeline, and for merged
+  multi-log exports the incarnation chain is stitched — the gap
+  between one incarnation's last event and its successor's first
+  becomes ``restart`` (minus any ``backoff`` that supervisor
+  ``cluster/restart`` instants declare inside the gap).
 """
 
 from __future__ import annotations
@@ -68,16 +77,126 @@ def _request_lane(ev: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _badput_tid(pid: int, category: str) -> int:
+    return int(hashlib.sha1(
+        f"badput:{pid}:{category}".encode()).hexdigest()[:8], 16)
+
+
+def _badput_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Synthetic per-process badput lanes.  Every instrument the
+    goodput ledger (telemetry/ledger.py) counts as badput also gets an
+    ``X`` slice on its own ``badput:<category>`` lane; incarnation gaps
+    in merged multi-log traces are stitched into ``restart``/``backoff``
+    slices the same way the ledger does it."""
+    spans: List[Any] = []       # (pid, t0, dur, category, args)
+    first_last: Dict[int, List[float]] = {}
+    proc_of_pid: Dict[int, Any] = {}
+    inc_of_pid: Dict[int, Any] = {}
+    restarts: List[Any] = []    # (ts, backoff_s)
+    supervisor_pids = set()
+
+    for ev in events:
+        kind = ev.get("kind")
+        pid = ev.get("pid", 0)
+        ts = float(ev.get("ts", 0.0))
+        fl = first_last.setdefault(pid, [ts, ts])
+        fl[0] = min(fl[0], ts)
+        fl[1] = max(fl[1], ts)
+        if kind == "run_start":
+            meta = ev.get("meta") or {}
+            if meta.get("role") == "supervisor":
+                supervisor_pids.add(pid)
+            if "process_index" in meta:
+                proc_of_pid[pid] = meta["process_index"]
+            if "incarnation" in meta:
+                inc_of_pid[pid] = meta["incarnation"]
+        elif kind == "compile":
+            dur = float(ev.get("dur", 0.0))
+            spans.append((pid, ts - dur, dur, "compile",
+                          {"name": ev.get("name", "?")}))
+        elif kind == "span_end":
+            name, dur = ev.get("name", ""), float(ev.get("dur", 0.0))
+            if name in ("data_wait", "checkpoint"):
+                spans.append((pid, ts - dur, dur, name, {}))
+        elif kind == "stage":
+            name, dur = ev.get("name", ""), float(ev.get("dur", 0.0))
+            if name == "resume/fast_forward":
+                spans.append((pid, ts - dur, dur, "replay",
+                              {"records": ev.get("records")}))
+            elif name == "checkpoint/restore":
+                spans.append((pid, ts - dur, dur, "checkpoint",
+                              {"source": ev.get("source")}))
+        elif kind == "event":
+            name = ev.get("name", "")
+            if name == "run/retry" and ev.get("backoff_s"):
+                dur = float(ev["backoff_s"])
+                spans.append((pid, ts - dur, dur, "retry_backoff",
+                              {"error": ev.get("error")}))
+            elif name == "straggler/timeout" and ev.get("budget_s"):
+                dur = float(ev["budget_s"])
+                spans.append((pid, ts - dur, dur, "straggler", {}))
+            elif name == "cluster/drain" and ev.get("dur"):
+                dur = float(ev["dur"])
+                spans.append((pid, ts - dur, dur, "drain", {}))
+            elif name == "cluster/restart":
+                restarts.append(
+                    (ts, float(ev.get("backoff_s", 0.0) or 0.0)))
+                supervisor_pids.add(pid)
+
+    # Incarnation gaps -> restart/backoff slices on the reborn pid.
+    chains: Dict[Any, List[Any]] = {}
+    for pid, (first, last) in first_last.items():
+        if pid in supervisor_pids:
+            continue
+        idx = proc_of_pid.get(pid)
+        if idx is not None:
+            chains.setdefault(idx, []).append((first, last, pid))
+    for incs in chains.values():
+        incs.sort()
+        for (_pf, pl, _ppid), (nf, _nl, npid) in zip(incs, incs[1:]):
+            gap = nf - pl
+            if gap <= 0:
+                continue
+            backoff = min(gap, sum(b for t, b in restarts
+                                   if pl - 1.0 <= t <= nf + 1.0))
+            if gap - backoff > 0:
+                spans.append((npid, pl, gap - backoff, "restart",
+                              {"incarnation": inc_of_pid.get(npid)}))
+            if backoff > 0:
+                spans.append((npid, pl + (gap - backoff), backoff,
+                              "backoff", {}))
+
+    out: List[Dict[str, Any]] = []
+    lanes = set()
+    for pid, t0, dur, cat, args in spans:
+        if dur <= 0:
+            continue
+        tid = _badput_tid(pid, cat)
+        if (pid, cat) not in lanes:
+            lanes.add((pid, cat))
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": tid, "ts": 0,
+                        "args": {"name": f"badput:{cat}"}})
+        out.append({"ph": "X", "name": cat, "cat": "badput",
+                    "pid": pid, "tid": tid, "ts": _us(t0),
+                    "dur": _us(dur),
+                    "args": {k: v for k, v in args.items()
+                             if v is not None}})
+    return out
+
+
 def chrome_trace(events: Iterable[Dict[str, Any]],
                  process_names: Dict[int, str] = None) -> Dict[str, Any]:
     """Build the ``{"traceEvents": [...]}`` object from parsed run
     events.  ``process_names`` labels pid lanes (the multi-log fleet
     export passes ``{os pid: "p<idx> (file)"}`` so Perfetto shows one
     named lane per process)."""
+    events = list(events)
     out: List[Dict[str, Any]] = []
     for pid, name in (process_names or {}).items():
         out.append({"ph": "M", "name": "process_name", "pid": pid,
                     "tid": 0, "ts": 0, "args": {"name": name}})
+    out.extend(_badput_events(events))
     for ev in events:
         kind = ev.get("kind")
         pid, tid, ts = ev.get("pid", 0), ev.get("tid", 0), ev.get("ts", 0.0)

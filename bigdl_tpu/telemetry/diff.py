@@ -13,6 +13,7 @@ present on both sides:
 - MFU                                  (higher is better, pct threshold)
 - compile / retrace counts             (count slack, default 0)
 - health-event counts (nonfinite steps, spikes, ...) (count slack)
+- goodput_pct / badput_s (run ledger)  (dedicated goodput threshold)
 
 Exit code contract (CI-ready): 0 = no regression, 1 = at least one
 metric regressed beyond its threshold, 2 = inputs not comparable.
@@ -28,7 +29,8 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = ["load_metrics", "run_log_metrics", "bench_metrics",
            "diff_metrics", "format_diff", "DEFAULT_THRESHOLD_PCT",
            "DEFAULT_COMPILE_THRESHOLD_PCT",
-           "DEFAULT_MEMORY_THRESHOLD_PCT"]
+           "DEFAULT_MEMORY_THRESHOLD_PCT",
+           "DEFAULT_GOODPUT_THRESHOLD_PCT", "MIN_GOODPUT_WALL_S"]
 
 DEFAULT_THRESHOLD_PCT = 10.0
 
@@ -47,6 +49,20 @@ DEFAULT_MEMORY_THRESHOLD_PCT = 10.0
 #: an order of magnitude, not ten percent.  ``bench.py --compile-budget``
 #: / ``telemetry diff --compile-threshold-pct`` tighten it per CI leg.
 DEFAULT_COMPILE_THRESHOLD_PCT = 50.0
+
+#: goodput regression threshold (telemetry/ledger.py,
+#: docs/observability.md "Goodput"): its own knob because goodput is the
+#: run-level roll-up the wall-time-reclaiming PRs (overlap, local SGD,
+#: autoscaling) gate against — a 5% drop in the fraction of wall time
+#: that trained the model is a real loss even when every step-level
+#: metric still passes the looser 10% default.  Applied to
+#: ``goodput_pct`` (higher is better) and total ``badput_s`` (lower).
+DEFAULT_GOODPUT_THRESHOLD_PCT = 5.0
+
+#: run logs with less wall time than this carry no goodput metrics —
+#: a run-level wall-time roll-up over a sub-second smoke run is noise,
+#: and gating on it would fail CI on scheduler jitter
+MIN_GOODPUT_WALL_S = 1.0
 
 #: metric name -> (direction, kind); direction "lower"/"higher" is the
 #: GOOD direction, kind "pct" uses the relative threshold, "count" the
@@ -113,6 +129,15 @@ _RULES: List[Tuple[str, str, str]] = [
     ("request_p99_ms", "lower", "pct"),
     ("slo_violations", "lower", "count"),
     (".slo_violations", "lower", "count"),
+    # goodput ledger (telemetry/ledger.py): the run-level roll-up —
+    # fraction of wall time that trained the model, and the badput
+    # seconds it lost — on the dedicated tighter threshold
+    # ("pct_goodput"); per run log (last goodput event, else folded
+    # fresh) and per bench row
+    ("goodput_pct", "higher", "pct_goodput"),
+    ("badput_s", "lower", "pct_goodput"),
+    (".goodput_pct", "higher", "pct_goodput"),
+    (".badput_s", "lower", "pct_goodput"),
 ]
 
 
@@ -181,6 +206,15 @@ def run_log_metrics(path: str) -> Dict[str, Any]:
     memory_events = [e for e in events if e.get("kind") == "memory"]
     if memory_events and memory_events[-1].get("peak_bytes") is not None:
         out["peak_hbm_bytes"] = float(memory_events[-1]["peak_bytes"])
+    # goodput roll-up (telemetry/ledger.py): the run's goodput event
+    # when end_run wrote one, else summarize() folded the raw events.
+    # Sub-second walls are all noise (a smoke run's goodput is whatever
+    # the interpreter was doing that millisecond) — don't offer them to
+    # the gate
+    gp = summary.get("goodput")
+    if gp and gp.get("wall_s", 0.0) >= MIN_GOODPUT_WALL_S:
+        out["goodput_pct"] = float(gp["goodput_pct"])
+        out["badput_s"] = float(gp.get("badput_s", 0.0))
     health = summary.get("health", {})
     out["health_events"] = sum(health.get("events", {}).values())
     out["nonfinite_steps"] = health.get("nonfinite_steps", 0)
@@ -260,10 +294,20 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
         # --memory-budget gate's input
         if row.get("peak_hbm_bytes") is not None:
             out[f"{name}.peak_hbm_bytes"] = float(row["peak_hbm_bytes"])
+        # goodput roll-up on bench rows (telemetry/ledger.py via the
+        # live telemetry.goodput() accessor at artifact time)
+        for key in ("goodput_pct", "badput_s"):
+            if row.get(key) is not None:
+                out[f"{name}.{key}"] = float(row[key])
     if doc.get("value") is not None and not doc.get("configs"):
         out["throughput"] = float(doc["value"])
     if doc.get("mfu") is not None:
         out["mfu"] = float(doc["mfu"])
+    # whole-artifact goodput (both benches stamp it off the run that
+    # produced the artifact)
+    for key in ("goodput_pct", "badput_s"):
+        if doc.get(key) is not None:
+            out[key] = float(doc[key])
     return out
 
 
@@ -288,7 +332,8 @@ def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
                  threshold_pct: float = DEFAULT_THRESHOLD_PCT,
                  count_slack: int = 0,
                  compile_threshold_pct: Optional[float] = None,
-                 memory_threshold_pct: Optional[float] = None
+                 memory_threshold_pct: Optional[float] = None,
+                 goodput_threshold_pct: Optional[float] = None
                  ) -> List[Dict[str, Any]]:
     """Compare metric dicts (A = baseline, B = candidate).  Returns one
     row per comparable metric: ``{name, a, b, delta_pct, better,
@@ -296,11 +341,15 @@ def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
     compile budget applied to ``compile_s`` metrics (None = the default
     :data:`DEFAULT_COMPILE_THRESHOLD_PCT`); ``memory_threshold_pct``
     the memory budget applied to ``peak_hbm_bytes`` metrics (None =
-    :data:`DEFAULT_MEMORY_THRESHOLD_PCT`)."""
+    :data:`DEFAULT_MEMORY_THRESHOLD_PCT`); ``goodput_threshold_pct``
+    the goodput gate applied to ``goodput_pct``/``badput_s`` metrics
+    (None = :data:`DEFAULT_GOODPUT_THRESHOLD_PCT`)."""
     if compile_threshold_pct is None:
         compile_threshold_pct = DEFAULT_COMPILE_THRESHOLD_PCT
     if memory_threshold_pct is None:
         memory_threshold_pct = DEFAULT_MEMORY_THRESHOLD_PCT
+    if goodput_threshold_pct is None:
+        goodput_threshold_pct = DEFAULT_GOODPUT_THRESHOLD_PCT
     rows: List[Dict[str, Any]] = []
     for name in sorted(set(a) & set(b)):
         rule = _rule_for(name)
@@ -324,6 +373,14 @@ def diff_metrics(a: Dict[str, Any], b: Dict[str, Any],
             regressed = worse and abs(delta_pct) > compile_threshold_pct
         elif kind == "pct_memory":
             regressed = worse and abs(delta_pct) > memory_threshold_pct
+        elif kind == "pct_goodput":
+            if name.endswith("goodput_pct"):
+                # already a percentage: compare in percentage POINTS —
+                # relative change would make a 10%->9.4% drop regress
+                # while 90%->85% (nine times the lost wall) passed
+                regressed = worse and abs(va - vb) > goodput_threshold_pct
+            else:
+                regressed = worse and abs(delta_pct) > goodput_threshold_pct
         else:
             regressed = worse and abs(delta_pct) > threshold_pct
         rows.append({"name": name, "a": va, "b": vb,
@@ -382,6 +439,10 @@ def main(argv=None) -> int:
                    help="memory budget: relative regression threshold "
                         "for peak_hbm_bytes metrics (default "
                         f"{DEFAULT_MEMORY_THRESHOLD_PCT})")
+    p.add_argument("--goodput-threshold-pct", type=float, default=None,
+                   help="goodput gate: relative regression threshold "
+                        "for goodput_pct/badput_s metrics (default "
+                        f"{DEFAULT_GOODPUT_THRESHOLD_PCT})")
     p.add_argument("--json", action="store_true",
                    help="emit rows as JSON instead of the table")
     args = p.parse_args(argv)
@@ -395,7 +456,8 @@ def main(argv=None) -> int:
     rows = diff_metrics(a, b, threshold_pct=args.threshold_pct,
                         count_slack=args.count_slack,
                         compile_threshold_pct=args.compile_threshold_pct,
-                        memory_threshold_pct=args.memory_threshold_pct)
+                        memory_threshold_pct=args.memory_threshold_pct,
+                        goodput_threshold_pct=args.goodput_threshold_pct)
     n_regressed = sum(r["regressed"] for r in rows)
     exit_code = 2 if not rows else (1 if n_regressed else 0)
     if args.json:
@@ -415,6 +477,10 @@ def main(argv=None) -> int:
                               (args.memory_threshold_pct
                                if args.memory_threshold_pct is not None
                                else DEFAULT_MEMORY_THRESHOLD_PCT),
+                          "goodput_threshold_pct":
+                              (args.goodput_threshold_pct
+                               if args.goodput_threshold_pct is not None
+                               else DEFAULT_GOODPUT_THRESHOLD_PCT),
                           "count_slack": args.count_slack,
                           "exit_code": exit_code}, indent=2))
     else:

@@ -49,10 +49,10 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from bigdl_tpu.telemetry import request_trace
+from bigdl_tpu.telemetry import ledger, request_trace
 
 __all__ = ["HostState", "FleetWatcher", "fleet_view", "blame",
-           "fleet_width", "apply_topology",
+           "fleet_width", "apply_topology", "fleet_goodput",
            "format_fleet_view", "fleet_openmetrics", "main",
            "WINDOW_STEPS", "SKEW_LAG_STEPS", "SKEW_MIN_EXCESS_S",
            "SKEW_REL_EXCESS"]
@@ -125,6 +125,10 @@ class HostState:
         # shared request_trace.RequestFold — one fold implementation
         # with the MetricsSink, so the two live views can't diverge
         self.requests = request_trace.RequestFold()
+        # shared goodput ledger (telemetry/ledger.py LedgerFold) — the
+        # same fold the MetricsSink serves on /status.goodput, so the
+        # per-host badput columns can't diverge from the host's own view
+        self.ledger = ledger.LedgerFold()
         # (step, ts, dur, components) rows, newest last
         self.window: deque = deque(maxlen=WINDOW_STEPS)
         self._pending: Dict[str, float] = {}
@@ -132,6 +136,7 @@ class HostState:
     # -- folding -------------------------------------------------------------
     def fold(self, events: List[Dict[str, Any]]) -> None:
         for ev in events:
+            self.ledger.fold_event(ev)
             kind = ev.get("kind")
             ts = ev.get("ts")
             if isinstance(ts, (int, float)):
@@ -280,7 +285,20 @@ class HostState:
         p50 = self._percentile(50)
         shares = {f"{c}_share": (comp[c] / p50 if p50 else 0.0)
                   for c in ("data_wait", "comms", "checkpoint", "compute")}
+        gp = self.ledger.snapshot()
+        badput_top = None
+        if gp and gp.get("wall_s"):
+            badput = gp.get("badput") or {}
+            cat = max(badput, key=badput.get, default=None)
+            if cat is not None and badput[cat] > 0:
+                badput_top = {"category": cat,
+                              "seconds": round(badput[cat], 3)}
         return {"path": self.path,
+                "goodput_pct": (gp.get("goodput_pct")
+                                if gp and gp.get("wall_s") else None),
+                "badput_s": (gp.get("badput_s")
+                             if gp and gp.get("wall_s") else None),
+                "badput_top": badput_top,
                 "process_index": self.process_index,
                 "last_step": self.last_step,
                 "age_s": (round(now - self.last_step_ts, 3)
@@ -353,6 +371,24 @@ def apply_topology(states: List[HostState]) -> Optional[Dict[str, Any]]:
             and st.process_index >= cur
             and (st.last_step_ts is None or st.last_step_ts <= ts))
     return width
+
+
+def fleet_goodput(hosts: Dict[str, Dict[str, Any]]
+                  ) -> Optional[Dict[str, Any]]:
+    """Fleet goodput = the WORST host's — on a synchronous step the
+    slowest host's wasted wall is every host's wasted wall, so the
+    fleet can never be doing better than its unluckiest member.
+    ``hosts`` is the label->row dict the views build; None when no row
+    carries a goodput number yet."""
+    worst: Optional[Dict[str, Any]] = None
+    for row in hosts.values():
+        pct = row.get("goodput_pct")
+        if pct is None:
+            continue
+        if worst is None or pct < worst["pct"]:
+            worst = {"pct": pct, "worst": row.get("process_index"),
+                     "badput_top": row.get("badput_top")}
+    return worst
 
 
 # -- skew blame ---------------------------------------------------------------
@@ -509,8 +545,10 @@ def fleet_view(runs: List[Tuple[str, List[Dict[str, Any]]]],
                           "p95_s": st._percentile(95),
                           "wall_s": rows[i]["wall_s"],
                           "nonfinite_steps": st.nonfinite_steps})
-    return {"hosts": {f"p{p['process_index']}": r
-                      for p, r in zip(processes, rows)},
+    hosts = {f"p{p['process_index']}": r
+             for p, r in zip(processes, rows)}
+    return {"hosts": hosts,
+            "goodput": fleet_goodput(hosts),
             "processes": processes,
             "step_lag": (max(last_steps) - min(last_steps))
             if last_steps else 0,
@@ -577,6 +615,13 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
                 hbm += (f" slowest {slow['trace_id']}"
                         f"@{slow.get('ms', 0.0):.0f}ms")
             hbm += "  "
+        good = ""
+        if r.get("goodput_pct") is not None:
+            good = f"good {r['goodput_pct']:3.0f}%  "
+            top = r.get("badput_top") or {}
+            if top.get("category"):
+                good += (f"bad {top['category']}:"
+                         f"{top['seconds']:.1f}s  ")
         lines.append(
             f"p{p['process_index']:<3} step {p['last_step']:<6} "
             f"age {age if age is not None else '?':>7}s  "
@@ -584,7 +629,7 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
             f"data {_pct(r.get('data_wait_share', 0.0))}  "
             f"comms {_pct(r.get('comms_share', 0.0))}  "
             f"ckpt {_pct(r.get('checkpoint_share', 0.0))}  "
-            f"{hbm}"
+            f"{good}{hbm}"
             f"nonfinite {p['nonfinite_steps']}"
             f"{'  DEPARTED' if r.get('departed') else ''}"
             f"{'  ENDED' if r.get('ended') else ''}  ({p['path']})")
@@ -595,6 +640,15 @@ def format_fleet_view(view: Dict[str, Any]) -> str:
             line += f"/{width['declared']} declared"
             if width["current"] != width["declared"]:
                 line += "  (DEGRADED — cluster resharded)"
+        lines.append(line)
+    fg = view.get("goodput")
+    if fg:
+        line = (f"fleet goodput: {fg['pct']:.1f}% "
+                f"(worst host: p{fg.get('worst')})")
+        top = fg.get("badput_top") or {}
+        if top.get("category"):
+            line += (f"  dominant badput {top['category']} "
+                     f"{top['seconds']:.1f}s")
         lines.append(line)
     lines.append(f"step lag (fastest - slowest last step): "
                  f"{view['step_lag']}")
@@ -732,12 +786,14 @@ class FleetWatcher:
         now = time.time()
         last_steps = [h.last_step for h in kept
                       if h.window and not h.departed]
+        hosts = {f"p{h.process_index}"
+                 if h.process_index is not None
+                 else f"?{i}": h.row(now)
+                 for i, h in enumerate(kept)}
         return {"dir": self.directory,
                 "files": len(self._tails),
-                "hosts": {f"p{h.process_index}"
-                          if h.process_index is not None
-                          else f"?{i}": h.row(now)
-                          for i, h in enumerate(kept)},
+                "hosts": hosts,
+                "goodput": fleet_goodput(hosts),
                 "lag_steps": (max(last_steps) - min(last_steps))
                 if last_steps else 0,
                 "width": width,
@@ -830,7 +886,12 @@ def fleet_openmetrics() -> List[str]:
                 ("bigdl_fleet_slo_ttft_burn", "slo_ttft_burn",
                  "TTFT SLO burn rate per replica"),
                 ("bigdl_fleet_slo_violations_total", "slo_violations",
-                 "requests over a declared SLO budget per replica")]
+                 "requests over a declared SLO budget per replica"),
+                ("bigdl_fleet_goodput_pct", "goodput_pct",
+                 "run-level goodput percent per host "
+                 "(telemetry/ledger.py)"),
+                ("bigdl_fleet_badput_seconds", "badput_s",
+                 "run-level badput seconds per host")]
     for metric, field, help_ in per_host:
         lines.append(f"# HELP {metric} {help_}")
         lines.append(f"# TYPE {metric} gauge")

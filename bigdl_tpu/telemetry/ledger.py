@@ -1,0 +1,615 @@
+"""Run-level goodput/badput ledger (docs/observability.md "Goodput").
+
+Answers the question production actually asks of a training job: *of the
+wall time this run held the hardware, how many seconds trained the
+model?*  Every step-level instrument already exists (stage timings,
+compile events, comms expected-vs-measured, checkpoint spans, the
+supervisor's restart instants); this module folds them into one
+exhaustive decomposition of wall time::
+
+    wall = compute + compile + data_wait + comms + straggler
+         + checkpoint + replay + retry_backoff + restart + backoff
+         + drain + idle
+
+with the conservation contract lifted from per-step (PR 14's request
+waterfalls) to the whole run: the categories must sum to wall time
+within a pinned tolerance, and a *blame* verdict names the dominant
+badput category with evidence.
+
+Three consumption shapes share one fold:
+
+- :class:`LedgerFold` — streaming, one event at a time.  Installed as a
+  side-accumulator by the telemetry runtime (the per-run ``goodput``
+  summary event + ``telemetry.goodput()``), by the /metrics sink
+  (``/status.goodput``, ``bigdl_goodput_pct``), and by the fleet
+  watcher's per-host state.
+- :func:`goodput_from_events` — fold a parsed single-process log.
+- :func:`ledger_from_events` — the offline multi-log stitcher: groups
+  run logs into per-process incarnation chains, classifies the
+  inter-incarnation gaps (supervisor backoff vs restart overhead) off
+  the ``cluster/restart`` instants, and checks conservation per chain
+  so time is never double-counted across a restart boundary.
+
+Category semantics (the taxonomy the docs pin):
+
+- ``compute``   productive: in-step device time after carving the
+  overheads below out of each step, plus validation spans (evaluating
+  the model is the job's purpose too).
+- ``compile``   XLA compilation (in-step first-iteration traces plus
+  AOT/warmup compiles outside any step).
+- ``data_wait`` input pipeline stalls (the ``data_wait`` span inside
+  each step).
+- ``comms``     unoverlapped collective time: the comms walker's
+  per-step measured (or expected) seconds times the step count.
+- ``straggler`` collective watchdog budgets burned waiting on a slow
+  or dead peer.
+- ``checkpoint`` save spans plus restore stages.
+- ``replay``    preempt-resume fast-forward through already-consumed
+  input records.
+- ``retry_backoff`` in-process retry sleeps (``run/retry``).
+- ``restart``/``backoff`` supervised incarnation gaps: the part of the
+  gap covered by the supervisor's recorded backoff vs the residual
+  process teardown + respawn overhead.
+- ``drain``     graceful drain before exit (serving drain span, the
+  supervisor's SIGTERM grace).
+- ``idle``      wall time with no attributable activity.
+
+Stdlib only — this is imported (lazily) by the tracer runtime and the
+metrics sink, which must work without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["BADPUT_CATEGORIES", "DEFAULT_TOLERANCE_PCT", "LedgerFold",
+           "goodput_from_events", "ledger_from_events", "blame_verdict",
+           "format_goodput", "goodput_main"]
+
+#: display/JSON order of the badput categories (compute is not badput)
+BADPUT_CATEGORIES: Tuple[str, ...] = (
+    "compile", "data_wait", "comms", "straggler", "checkpoint", "replay",
+    "retry_backoff", "restart", "backoff", "drain", "idle")
+
+#: run-level conservation tolerance: |compute + Σbadput - wall| / wall
+DEFAULT_TOLERANCE_PCT = 5.0
+
+#: when a restart instant's timestamp must be matched to an incarnation
+#: gap, allow this much slack (instants are emitted by the supervisor,
+#: whose clock samples bracket the children's first/last events)
+_GAP_SLACK_S = 1.0
+
+
+def _num(x, default=0.0) -> float:
+    return float(x) if isinstance(x, (int, float)) \
+        and not isinstance(x, bool) else default
+
+
+class LedgerFold:
+    """Streaming accumulator for one process's event stream.
+
+    ``fold_event`` is cheap (one kind dispatch, a few float adds) so it
+    can ride inside the /metrics sink's emit path; ``snapshot`` runs the
+    decomposition on demand and never mutates state.
+    """
+
+    def __init__(self):
+        self.first_ts: Optional[float] = None
+        self.last_ts: Optional[float] = None
+        self.step_n = 0
+        self.step_s = 0.0
+        self.data_wait_s = 0.0
+        self.data_wait_n = 0
+        self.compile_s = 0.0
+        self.compile_n = 0
+        self.validation_s = 0.0
+        self.checkpoint_s = 0.0
+        self.checkpoint_n = 0
+        self.replay_s = 0.0
+        self.replay_records = 0
+        self.retry_backoff_s = 0.0
+        self.retry_n = 0
+        #: furthest point in time any retry's charged sleep reaches
+        #: (``ts + backoff_s``) — ``run/retry`` is emitted BEFORE the
+        #: sleep, so a worker killed mid-backoff charged time the log's
+        #: wall never contained; snapshot() trims the unelapsed tail
+        self.retry_extent_ts: Optional[float] = None
+        self.drain_s = 0.0
+        self.drain_n = 0
+        self.straggler_s = 0.0
+        self.straggler_n = 0
+        self.comms_per_step_s = 0.0
+        #: cluster/restart instants seen in THIS stream (supervisor
+        #: logs); (ts, backoff_s, exits) — evidence + gap classification
+        self.restarts: List[Tuple[float, float, Any]] = []
+
+    # -- folding -----------------------------------------------------------
+    def fold_event(self, ev: Dict[str, Any]) -> None:
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+            if self.first_ts is None or ts < self.first_ts:
+                self.first_ts = float(ts)
+            if self.last_ts is None or ts > self.last_ts:
+                self.last_ts = float(ts)
+        kind = ev.get("kind")
+        if kind == "step":
+            self.step_n += 1
+            self.step_s += _num(ev.get("dur"))
+        elif kind == "compile":
+            self.compile_n += 1
+            self.compile_s += _num(ev.get("dur"))
+        elif kind == "span_end":
+            name, dur = ev.get("name"), _num(ev.get("dur"))
+            if name == "data_wait":
+                self.data_wait_n += 1
+                self.data_wait_s += dur
+            elif name == "validation":
+                self.validation_s += dur
+            elif name == "checkpoint":
+                self.checkpoint_n += 1
+                self.checkpoint_s += dur
+            elif name == "serve/drain":
+                self.drain_n += 1
+                self.drain_s += dur
+        elif kind == "stage":
+            name, dur = ev.get("name"), _num(ev.get("dur"))
+            if name == "resume/fast_forward":
+                self.replay_s += dur
+                self.replay_records += int(_num(ev.get("records")))
+            elif name == "checkpoint/restore":
+                self.checkpoint_n += 1
+                self.checkpoint_s += dur
+        elif kind == "event":
+            name = ev.get("name")
+            if name == "run/retry":
+                self.retry_n += 1
+                backoff = _num(ev.get("backoff_s"))
+                self.retry_backoff_s += backoff
+                if isinstance(ts, (int, float)) \
+                        and not isinstance(ts, bool):
+                    extent = float(ts) + backoff
+                    if self.retry_extent_ts is None \
+                            or extent > self.retry_extent_ts:
+                        self.retry_extent_ts = extent
+            elif name == "straggler/timeout":
+                self.straggler_n += 1
+                self.straggler_s += _num(ev.get("budget_s"))
+            elif name == "cluster/drain":
+                self.drain_n += 1
+                self.drain_s += _num(ev.get("dur"))
+            elif name == "cluster/restart":
+                self.restarts.append((_num(ev.get("ts")),
+                                      _num(ev.get("backoff_s")),
+                                      ev.get("exits")))
+        elif kind == "comms":
+            # latest per-step collective seconds: measured when the
+            # walker timed the step, predicted otherwise
+            per = ev.get("measured_s")
+            if not isinstance(per, (int, float)) or isinstance(per, bool):
+                per = ev.get("expected_s")
+            if isinstance(per, (int, float)) and not isinstance(per, bool):
+                self.comms_per_step_s = float(per)
+
+    def fold_events(self, events: Iterable[Dict[str, Any]]) -> None:
+        for ev in events:
+            self.fold_event(ev)
+
+    # sink protocol: a LedgerFold can ride directly on a Tracer's sink
+    # list (the runtime installs one per run for the end-of-run goodput
+    # event and the live ``telemetry.goodput()`` accessor)
+    def emit(self, event: Dict[str, Any]) -> None:
+        self.fold_event(event)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- decomposition -----------------------------------------------------
+    def snapshot(self) -> Optional[Dict[str, Any]]:
+        """The current decomposition, or None before any event.
+
+        In-step overheads (data_wait, compile, comms, straggler) are
+        carved out of the summed step durations — each capped at the
+        remainder so a mis-scaled instrument can never push in-step
+        badput past the time the steps actually took; what is left of
+        the step time is productive compute.  Measured intervals outside
+        steps (validation, checkpoint, replay, backoffs, drain) are
+        charged at face value; ``idle`` is the unexplained residual,
+        floored at zero.  Leftover compile time (AOT/warmup compiles
+        that ran outside any step) is reassigned from idle.  When the
+        instruments don't overlap, the categories sum to wall exactly;
+        overlap shows up as a conservation error the ±tolerance check
+        catches.
+        """
+        if self.first_ts is None or self.last_ts is None:
+            return None
+        wall = max(0.0, self.last_ts - self.first_ts)
+        in_step = min(self.step_s, wall)
+        rem = in_step
+        data_wait = min(self.data_wait_s, rem)
+        rem -= data_wait
+        compile_in = min(self.compile_s, rem)
+        rem -= compile_in
+        comms = min(self.comms_per_step_s * self.step_n, rem)
+        rem -= comms
+        straggler = min(self.straggler_s, rem)
+        rem -= straggler
+        compute_step = rem
+        restart_backoff = sum(b for _, b, _ in self.restarts)
+        # trim the retry sleep that was charged but never slept: the
+        # instant fires BEFORE the backoff, so a process killed
+        # mid-backoff would otherwise carry badput past its own wall
+        retry_backoff = self.retry_backoff_s
+        if self.retry_extent_ts is not None:
+            retry_backoff -= min(
+                retry_backoff,
+                max(0.0, self.retry_extent_ts - self.last_ts))
+        outside = (self.validation_s + self.checkpoint_s + self.replay_s
+                   + retry_backoff + self.drain_s + restart_backoff)
+        idle = max(0.0, wall - in_step - outside)
+        extra_compile = min(max(0.0, self.compile_s - compile_in), idle)
+        idle -= extra_compile
+        compute = compute_step + self.validation_s
+        badput = {
+            "compile": compile_in + extra_compile,
+            "data_wait": data_wait,
+            "comms": comms,
+            "straggler": straggler,
+            "checkpoint": self.checkpoint_s,
+            "replay": self.replay_s,
+            "retry_backoff": retry_backoff,
+            "restart": 0.0,
+            "backoff": restart_backoff,
+            "drain": self.drain_s,
+            "idle": idle,
+        }
+        counts = {
+            "steps": self.step_n,
+            "compiles": self.compile_n,
+            "data_waits": self.data_wait_n,
+            "checkpoints": self.checkpoint_n,
+            "replay_records": self.replay_records,
+            "retries": self.retry_n,
+            "stragglers": self.straggler_n,
+            "drains": self.drain_n,
+            "restarts": len(self.restarts),
+            "incarnations": 1,
+            "exits": [x for _, _, x in self.restarts if x is not None],
+        }
+        return _finish_report(wall, compute, badput, counts)
+
+    def event_fields(self) -> Optional[Dict[str, Any]]:
+        """Fields of the per-run ``goodput`` summary event (None before
+        any event): the snapshot plus the blame verdict."""
+        report = self.snapshot()
+        if report is None:
+            return None
+        report["blame"] = blame_verdict(report)
+        return report
+
+
+def _finish_report(wall: float, compute: float, badput: Dict[str, float],
+                   counts: Dict[str, Any]) -> Dict[str, Any]:
+    badput = {k: round(max(0.0, v), 6) for k, v in badput.items()}
+    badput_total = sum(badput.values())
+    total = compute + badput_total
+    err_pct = 100.0 * abs(total - wall) / wall if wall > 0 else 0.0
+    return {
+        "wall_s": round(wall, 6),
+        "compute_s": round(compute, 6),
+        "badput_s": round(badput_total, 6),
+        "goodput_pct": round(100.0 * compute / wall, 3) if wall > 0 else 0.0,
+        "badput": badput,
+        "counts": counts,
+        "conservation_err_pct": round(err_pct, 3),
+    }
+
+
+# -- blame -------------------------------------------------------------------
+def _evidence(cause: str, seconds: float, counts: Dict[str, Any]) -> str:
+    if cause == "compile":
+        return (f"{counts.get('compiles', 0)} compilation(s) totalling "
+                f"{seconds:.1f}s")
+    if cause == "data_wait":
+        return (f"input pipeline stalled {counts.get('data_waits', 0)} "
+                f"time(s) across {counts.get('steps', 0)} step(s)")
+    if cause == "comms":
+        return (f"unoverlapped collective time across "
+                f"{counts.get('steps', 0)} step(s)")
+    if cause == "straggler":
+        return (f"{counts.get('stragglers', 0)} straggler watchdog "
+                f"budget(s) burned")
+    if cause == "checkpoint":
+        return (f"{counts.get('checkpoints', 0)} checkpoint "
+                f"save/restore interval(s)")
+    if cause == "replay":
+        return (f"fast-forward replay of "
+                f"{counts.get('replay_records', 0)} record(s)")
+    if cause == "retry_backoff":
+        return f"{counts.get('retries', 0)} in-process retry backoff(s)"
+    if cause == "restart":
+        exits = counts.get("exits") or []
+        tail = f"; exits {exits}" if exits else ""
+        return (f"{counts.get('restarts', 0)} supervised restart(s) "
+                f"across {counts.get('incarnations', 1)} "
+                f"incarnation(s){tail}")
+    if cause == "backoff":
+        return (f"supervisor backoff before "
+                f"{counts.get('restarts', 0)} restart(s)")
+    if cause == "drain":
+        return f"{counts.get('drains', 0)} graceful drain(s) before exit"
+    if cause == "idle":
+        return "wall time with no attributable activity"
+    return ""
+
+
+def blame_verdict(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Name the dominant badput category with evidence, or ``none``
+    when badput is negligible (< 1% of wall)."""
+    wall = report.get("wall_s", 0.0)
+    badput = report.get("badput") or {}
+    counts = report.get("counts") or {}
+    cause, seconds = "none", 0.0
+    for cat in BADPUT_CATEGORIES:
+        if badput.get(cat, 0.0) > seconds:
+            cause, seconds = cat, badput[cat]
+    total = sum(badput.values())
+    if seconds <= 0 or (wall > 0 and total < 0.01 * wall):
+        return {"cause": "none", "seconds": 0.0, "share_pct": 0.0,
+                "evidence": "badput negligible"}
+    share = 100.0 * seconds / total if total > 0 else 0.0
+    return {"cause": cause, "seconds": round(seconds, 6),
+            "share_pct": round(share, 1),
+            "evidence": _evidence(cause, seconds, counts)}
+
+
+# -- single-log / multi-log entry points -------------------------------------
+def goodput_from_events(
+        events: Iterable[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Fold one parsed event list into a goodput report (with blame),
+    or None when the list is empty."""
+    fold = LedgerFold()
+    fold.fold_events(events)
+    report = fold.snapshot()
+    if report is not None:
+        report["blame"] = blame_verdict(report)
+    return report
+
+
+def _run_meta(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    for ev in events:
+        if ev.get("kind") == "run_start" and isinstance(ev.get("meta"),
+                                                        dict):
+            return ev["meta"]
+    return {}
+
+
+def _sum_counts(into: Dict[str, Any], counts: Dict[str, Any]) -> None:
+    for k, v in counts.items():
+        if k == "exits":
+            into.setdefault("exits", []).extend(v or [])
+        else:
+            into[k] = into.get(k, 0) + (v or 0)
+
+
+def ledger_from_events(runs: Sequence[Tuple[str, Sequence[Dict[str, Any]]]],
+                       tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+                       ) -> Optional[Dict[str, Any]]:
+    """Stitch one or more run logs into the full-run ledger.
+
+    ``runs`` is a list of ``(path, parsed events)``.  Logs carrying a
+    supervisor role (``meta.role == "supervisor"`` or any
+    ``cluster/restart`` instant) form the supervisor timeline: their
+    restart instants classify the workers' inter-incarnation gaps, but
+    they do not contribute a wall-time chain of their own — the same
+    seconds already belong to the worker chains.  Worker logs group by
+    ``meta.process_index`` into incarnation chains ordered by start
+    time; each chain's wall is the sum of its incarnation walls plus
+    the gaps between them (so no second is counted twice across a
+    restart boundary), each gap split into supervisor ``backoff`` vs
+    residual ``restart`` overhead.  Conservation is checked per chain.
+
+    Returns None when no run has any events.
+    """
+    folded = []
+    restart_instants: List[Tuple[float, float, Any]] = []
+    n_supervisor = 0
+    for path, events in runs:
+        fold = LedgerFold()
+        fold.fold_events(events)
+        report = fold.snapshot()
+        if report is None:
+            continue
+        meta = _run_meta(events)
+        is_supervisor = (meta.get("role") == "supervisor"
+                         or bool(fold.restarts))
+        restart_instants.extend(fold.restarts)
+        if is_supervisor:
+            n_supervisor += 1
+        folded.append({"path": path, "meta": meta, "fold": fold,
+                       "report": report, "supervisor": is_supervisor})
+    if not folded:
+        return None
+    workers = [f for f in folded if not f["supervisor"]]
+    if not workers:  # supervisor-only input: fold it as its own chain
+        workers = folded
+    chains: Dict[Any, List[Dict[str, Any]]] = {}
+    for f in workers:
+        pidx = f["meta"].get("process_index", 0)
+        chains.setdefault(pidx, []).append(f)
+
+    chain_reports = []
+    totals_badput = {c: 0.0 for c in BADPUT_CATEGORIES}
+    totals_counts: Dict[str, Any] = {}
+    total_wall = total_compute = 0.0
+    for pidx in sorted(chains, key=lambda x: (str(type(x)), str(x))):
+        incs = sorted(chains[pidx], key=lambda f: (
+            f["fold"].first_ts or 0.0,
+            _num(f["meta"].get("incarnation"))))
+        wall = compute = 0.0
+        badput = {c: 0.0 for c in BADPUT_CATEGORIES}
+        counts: Dict[str, Any] = {}
+        for f in incs:
+            r = f["report"]
+            wall += r["wall_s"]
+            compute += r["compute_s"]
+            for c in BADPUT_CATEGORIES:
+                badput[c] += r["badput"].get(c, 0.0)
+            _sum_counts(counts, r["counts"])
+        counts["incarnations"] = len(incs)
+        gap_restart = gap_backoff = 0.0
+        for prev, nxt in zip(incs, incs[1:]):
+            lo = (prev["fold"].last_ts or 0.0) - _GAP_SLACK_S
+            hi = (nxt["fold"].first_ts or 0.0) + _GAP_SLACK_S
+            gap = max(0.0, (nxt["fold"].first_ts or 0.0)
+                      - (prev["fold"].last_ts or 0.0))
+            booked = sum(b for ts, b, _ in restart_instants
+                         if lo <= ts <= hi)
+            backoff = min(gap, booked)
+            gap_backoff += backoff
+            gap_restart += gap - backoff
+            wall += gap
+        if len(incs) > 1:
+            counts["restarts"] = max(counts.get("restarts", 0),
+                                     len(incs) - 1)
+            exits = [x for _, _, x in restart_instants if x is not None]
+            if exits and not counts.get("exits"):
+                counts["exits"] = exits
+        badput["restart"] += gap_restart
+        badput["backoff"] += gap_backoff
+        report = _finish_report(wall, compute, badput, counts)
+        report["process_index"] = pidx
+        report["incarnations"] = len(incs)
+        report["paths"] = [f["path"] for f in incs]
+        report["ok"] = report["conservation_err_pct"] <= tolerance_pct
+        chain_reports.append(report)
+        total_wall += wall
+        total_compute += compute
+        for c in BADPUT_CATEGORIES:
+            totals_badput[c] += badput[c]
+        _sum_counts(totals_counts, counts)
+
+    out = _finish_report(total_wall, total_compute, totals_badput,
+                         totals_counts)
+    out["blame"] = blame_verdict(out)
+    out["chains"] = chain_reports
+    out["n_runs"] = len(folded)
+    out["n_supervisor_runs"] = n_supervisor
+    worst = max((c["conservation_err_pct"] for c in chain_reports),
+                default=0.0)
+    out["conservation"] = {
+        "tolerance_pct": tolerance_pct,
+        "worst_err_pct": worst,
+        "ok": all(c["ok"] for c in chain_reports),
+    }
+    return out
+
+
+# -- rendering + CLI ---------------------------------------------------------
+def format_goodput(report: Dict[str, Any]) -> str:
+    lines = ["== goodput =="]
+    lines.append(f"wall {report['wall_s']:.1f}s   "
+                 f"compute {report['compute_s']:.1f}s   "
+                 f"goodput {report['goodput_pct']:.1f}%   "
+                 f"badput {report['badput_s']:.1f}s")
+    badput = report.get("badput") or {}
+    total = sum(badput.values())
+    nonzero = [(c, badput[c]) for c in BADPUT_CATEGORIES
+               if badput.get(c, 0.0) > 0]
+    nonzero.sort(key=lambda kv: -kv[1])
+    if nonzero:
+        lines.append("badput by category:")
+        for cat, s in nonzero:
+            share = 100.0 * s / total if total > 0 else 0.0
+            lines.append(f"  {cat:<14} {s:>9.2f}s  {share:5.1f}%")
+    for chain in report.get("chains") or []:
+        flag = "ok" if chain.get("ok") else "CONSERVATION VIOLATED"
+        lines.append(
+            f"chain p{chain.get('process_index')}: "
+            f"{chain.get('incarnations', 1)} incarnation(s)   "
+            f"wall {chain['wall_s']:.1f}s   "
+            f"goodput {chain['goodput_pct']:.1f}%   "
+            f"err {chain['conservation_err_pct']:.1f}% {flag}")
+    blame = report.get("blame") or {}
+    if blame.get("cause", "none") != "none":
+        lines.append(f"blame: {blame['cause']} ({blame['seconds']:.1f}s, "
+                     f"{blame['share_pct']:.0f}% of badput) — "
+                     f"{blame['evidence']}")
+    else:
+        lines.append("blame: none (badput negligible)")
+    cons = report.get("conservation")
+    if cons:
+        verdict = "ok" if cons["ok"] else "VIOLATED"
+        lines.append(f"conservation: {verdict} (worst err "
+                     f"{cons['worst_err_pct']:.1f}% vs "
+                     f"{cons['tolerance_pct']:.1f}% tolerance)")
+    return "\n".join(lines)
+
+
+def discover_logs(supervise_dir: str) -> List[str]:
+    """All run logs under a supervised telemetry dir, recursively —
+    the supervisor's own log plus every incarnation's worker logs."""
+    return sorted(glob.glob(os.path.join(supervise_dir, "**",
+                                         "run-*.jsonl"), recursive=True))
+
+
+def goodput_main(argv=None) -> int:
+    """``telemetry goodput`` — exit 0 on a conserving ledger, 1 on a
+    conservation violation, 2 when there is nothing to read."""
+    from bigdl_tpu.telemetry import schema
+
+    p = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.telemetry goodput",
+        description="run-level goodput/badput ledger over one or more "
+                    "run logs (a supervised incarnation chain stitches "
+                    "into one timeline)")
+    p.add_argument("runs", nargs="*", metavar="RUN_JSONL",
+                   help="run logs to fold (merged into one ledger)")
+    p.add_argument("--supervise-dir", metavar="DIR",
+                   help="fold every run-*.jsonl under DIR (recursive) — "
+                        "point it at a supervised run's telemetry dir")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--tolerance-pct", type=float,
+                   default=DEFAULT_TOLERANCE_PCT,
+                   help="conservation tolerance (default %(default)s%%)")
+    args = p.parse_args(argv)
+
+    paths = list(args.runs)
+    if args.supervise_dir:
+        paths.extend(x for x in discover_logs(args.supervise_dir)
+                     if x not in paths)
+    if not paths:
+        print("no run logs: pass run.jsonl paths or --supervise-dir",
+              file=sys.stderr)
+        return 2
+    runs = []
+    for path in paths:
+        try:
+            events, errors = schema.read_events(path)
+        except OSError as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            return 2
+        for err in errors:
+            print(f"{path}: {err}", file=sys.stderr)
+        runs.append((path, events))
+    report = ledger_from_events(runs, tolerance_pct=args.tolerance_pct)
+    if report is None:
+        print("no events in any run log", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_goodput(report))
+    return 0 if report["conservation"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(goodput_main())

@@ -54,6 +54,12 @@ def _request_fold():
     return RequestFold()
 
 
+def _ledger_fold():
+    # lazy for the same reason
+    from bigdl_tpu.telemetry.ledger import LedgerFold
+    return LedgerFold()
+
+
 def _metric_name(name: str, prefix: str = "bigdl_") -> str:
     """Telemetry stream name -> legal Prometheus metric name."""
     return prefix + _NAME_RE.sub("_", str(name)).strip("_")
@@ -110,11 +116,16 @@ class MetricsSink:
         # docs/sparse.md): the latest static per-step caps —
         # tpu_watch's sparse= block
         self.sparse: Dict[str, Any] = {}
+        # run-level goodput/badput ledger (telemetry/ledger.py): every
+        # event folds into it, /status.goodput and the
+        # bigdl_goodput_pct / bigdl_badput_seconds gauges read it
+        self.ledger = _ledger_fold()
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
         kind = event.get("kind")
         with self._lock:
+            self.ledger.fold_event(event)
             if kind == "run_start":
                 self.meta.update(event.get("meta") or {})
             elif kind == "step":
@@ -248,7 +259,8 @@ class MetricsSink:
                         "slowest": dict(self.requests.slowest)},
                     "comms": dict(self.last_comms),
                     "memory": dict(self.last_memory),
-                    "sparse": dict(self.sparse)}
+                    "sparse": dict(self.sparse),
+                    "goodput": self.ledger.event_fields() or {}}
 
     def openmetrics(self) -> str:
         """Prometheus/OpenMetrics exposition text of the current state."""
@@ -364,6 +376,21 @@ class MetricsSink:
                        self.last_memory.get("limit_bytes")
                        or self.last_memory.get("hbm_limit_bytes"),
                        "per-device HBM limit")
+            gp = self.ledger.snapshot()
+            if gp and gp.get("wall_s"):
+                sample("bigdl_goodput_pct", "gauge",
+                       gp.get("goodput_pct"),
+                       "run-level goodput percent (productive compute "
+                       "over wall time, telemetry/ledger.py)")
+                # per-category badput needs a second label, which
+                # sample() doesn't speak — emit the family by hand
+                lines.append("# HELP bigdl_badput_seconds run-level "
+                             "badput seconds by category")
+                lines.append("# TYPE bigdl_badput_seconds gauge")
+                for cat, s in sorted((gp.get("badput") or {}).items()):
+                    lines.append(
+                        f'bigdl_badput_seconds{{process_index="{pidx}",'
+                        f'category="{cat}"}} {float(s):g}')
             for name, count in sorted(self.events.items()):
                 sample(_metric_name(name, "bigdl_event_") + "_total",
                        "counter", count, f"instant events named {name}")

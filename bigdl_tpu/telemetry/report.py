@@ -37,6 +37,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     facts: Dict[str, Any] = {}
     attribution: Optional[Dict[str, Any]] = None
     memory: Optional[Dict[str, Any]] = None
+    goodput: Optional[Dict[str, Any]] = None
     health: Dict[str, Any] = {"probes": 0, "nonfinite_steps": 0,
                               "events": {}, "last": {}}
     t0 = t1 = None
@@ -99,6 +100,9 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "memory":
             memory = {k: v for k, v in ev.items()
                       if k not in ("v", "ts", "pid", "tid", "kind")}
+        elif kind == "goodput":
+            goodput = {k: v for k, v in ev.items()
+                       if k not in ("v", "ts", "pid", "tid", "kind")}
 
     for row in stages.values():
         row["mean_s"] = row["total_s"] / row["n"] if row["n"] else 0.0
@@ -128,6 +132,13 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                            facts["peak_flops_per_device"],
                            int(facts.get("device_count", 1)))
 
+    if goodput is None and events:
+        # runs that crashed before end_run never wrote their goodput
+        # summary event — fold the raw events instead
+        from bigdl_tpu.telemetry import ledger
+
+        goodput = ledger.goodput_from_events(events)
+
     return {"meta": meta,
             "wall_s": (t1 - t0) if (t0 is not None and t1 is not None)
             else 0.0,
@@ -135,7 +146,8 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "compiles": compiles, "retraces": retraces,
             "events": instants, "counters": counters, "gauges": gauges,
             "device_facts": facts, "mfu": mfu, "health": health,
-            "attribution": attribution, "memory": memory}
+            "attribution": attribution, "memory": memory,
+            "goodput": goodput}
 
 
 def _fmt_bytes(n: float) -> str:
@@ -176,6 +188,26 @@ def format_summary(summary: Dict[str, Any],
         if "throughput_mean" in st:
             lines.append(f"throughput: {st['throughput_mean']:.1f} "
                          f"records/s (mean)")
+
+    gp = summary.get("goodput")
+    if gp and gp.get("wall_s"):
+        from bigdl_tpu.telemetry.ledger import BADPUT_CATEGORIES
+
+        lines.append("")
+        lines.append("-- goodput --")
+        lines.append(f"goodput           {gp['goodput_pct']:.1f}%  "
+                     f"(compute {gp['compute_s']:.2f}s of "
+                     f"{gp['wall_s']:.2f}s wall; badput "
+                     f"{gp['badput_s']:.2f}s)")
+        badput = gp.get("badput") or {}
+        top = sorted(((c, badput[c]) for c in BADPUT_CATEGORIES
+                      if badput.get(c, 0.0) > 0), key=lambda kv: -kv[1])
+        for cat, s in top[:3]:
+            lines.append(f"badput {cat:<10} {s:9.2f} s")
+        blame = gp.get("blame") or {}
+        if blame.get("cause", "none") != "none":
+            lines.append(f"blame             {blame['cause']} — "
+                         f"{blame.get('evidence', '')}")
 
     if summary["stages"]:
         lines.append("")

@@ -76,6 +76,13 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     # expected_s / measured_s travel as extra fields — the raw material
     # for `telemetry diff`'s comms_bytes/comms_s and fleet skew blame
     "comms": {"count": (int,), "bytes": _NUM},
+    # per-run goodput/badput ledger (telemetry/ledger.py): emitted once
+    # at end_run — goodput_pct = 100*compute/wall, wall_s = run wall
+    # seconds; compute_s / badput_s / badput (per-category seconds) /
+    # counts / blame / conservation_err_pct travel as extra fields — the
+    # raw material for `telemetry diff`'s goodput gate and the bench
+    # rows' goodput columns
+    "goodput": {"goodput_pct": _NUM, "wall_s": _NUM},
     # per-step memory attribution (telemetry/memory.py): peak_bytes =
     # predicted per-device peak HBM (args + live-buffer-timeline temp
     # peak off the scheduled post-opt HLO); categories / rows / largest
@@ -140,6 +147,11 @@ STREAM_NAMES = frozenset({
     # from_n/to_n/declared_n).  The fleet view folds it so hosts of a
     # legitimately-shrunk cluster are marked departed, not stalled.
     "cluster/reshard",
+    # goodput ledger inputs (telemetry/ledger.py): checkpoint-restore
+    # wall (stage), preempt-resume fast-forward replay (stage), and the
+    # supervisor's drain interval (instant with dur) — the measured
+    # out-of-step intervals the run-level conservation check needs
+    "checkpoint/restore", "resume/fast_forward", "cluster/drain",
     # fleet aggregation (telemetry/fleet.py): the coordinator's live
     # watcher publishes the completed-step gap and the blamed per-step
     # excess as gauges, and a rate-limited skew-blame instant whenever

@@ -367,6 +367,10 @@ def test_space_to_depth_input_exact(h, w, k, s, p):
     from bigdl_tpu.nn.fuse import space_to_depth_input
 
     RNG.set_seed(8)
+    # the grad-scatter comparison below sits at rtol=1e-4 — pin the
+    # GLOBAL numpy stream too, or the draw (and thus the accumulated
+    # rounding) depends on whichever test ran before in the process
+    np.random.seed(8)
     conv = nn.SpatialConvolution(3, 8, k, k, s, s, p, p)
     ref_model = nn.Sequential(conv, nn.ReLU(True))
     x = np.random.randn(2, 3, h, w).astype(np.float32)
@@ -405,9 +409,14 @@ def test_space_to_depth_input_exact(h, w, k, s, p):
                     if dx >= k:
                         continue
                     ch = (np.arange(3) * s + a_h) * s + a_w
+                    # atol scales with the grad magnitude: a near-zero
+                    # element is the CANCELLATION of ~h*w products of
+                    # O(max|g|) — holding it to 1e-5 absolute asserts
+                    # more precision than the f32 sum carries
                     np.testing.assert_allclose(
                         gw[:, ch, j_h, j_w], g_ref[:, :, dy, dx],
-                        rtol=1e-4, atol=1e-5)
+                        rtol=1e-4,
+                        atol=1e-6 * max(1.0, np.abs(g_ref).max()))
 
 
 def test_space_to_depth_on_graph_input_conv():

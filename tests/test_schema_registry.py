@@ -66,7 +66,11 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           # reverts every embedding gradient to the dense table
           # all-reduce and loses the dlrm bench/serving tenant
           "bigdl_tpu/nn/layers/embedding.py",
-          "bigdl_tpu/models/dlrm.py"]
+          "bigdl_tpu/models/dlrm.py",
+          # goodput ledger (ISSUE 18): a silent drop loses the
+          # wall-time conservation contract and every goodput surface
+          # (end-of-run event, CLI fold, diff/bench gates)
+          "bigdl_tpu/telemetry/ledger.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

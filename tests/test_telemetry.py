@@ -192,7 +192,7 @@ def test_jsonl_roundtrip_and_validate_run(tmp_path):
             telemetry.instant("marker", detail="hello")
     n, errors = schema.validate_run(path)
     assert errors == []
-    assert n == 6  # run_start, counter, begin, event, end, run_end
+    assert n == 7  # run_start, counter, begin, event, end, goodput, run_end
     events, parse_errors = schema.read_events(path)
     assert parse_errors == []
     assert events[0]["kind"] == "run_start"

@@ -93,6 +93,10 @@ PINNED_MODULES = [
     # dlrm.py drops the recsys scenario both bench harnesses gate
     "bigdl_tpu/nn/layers/embedding.py",
     "bigdl_tpu/models/dlrm.py",
+    # goodput ledger (ISSUE 18): losing ledger.py silently drops the
+    # run-level wall-time accounting every surface folds (goodput
+    # event, /status.goodput, fleet columns, diff/bench gates)
+    "bigdl_tpu/telemetry/ledger.py",
 ]
 
 

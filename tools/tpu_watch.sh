@@ -62,6 +62,17 @@ if not (cache.get("hits") or cache.get("misses")):
 hits, misses = cache.get("hits", 0), cache.get("misses", 0)
 if hits or misses:
     line += f" cache={hits}h/{misses}m"
+# goodput ledger (telemetry/ledger.py): live share of wall time spent
+# training plus the dominant badput category — a babysitter sees "the
+# job holds the slice but only 60% of it trains" without waiting for
+# the post-run `telemetry goodput` fold
+gp = st.get("goodput") or {}
+if gp.get("wall_s"):
+    line += f" goodput={gp.get('goodput_pct', 0):.0f}%"
+    bad = gp.get("badput") or {}
+    worst = max(bad.items(), key=lambda kv: kv[1], default=None)
+    if worst and worst[1] > 0:
+        line += f" badput={worst[0]}:{worst[1]:.0f}s"
 # on-demand profiler + flight recorder (telemetry/profiler.py,
 # telemetry/flight.py): show a capture in flight / the last artifacts so
 # a sweep babysitter knows a POST /profile actually landed
