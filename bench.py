@@ -521,6 +521,81 @@ def _run_config_timed(name, batch, iters):
     return out
 
 
+def _local_sgd_leg(mode, h, iters, mesh, batch=128):
+    """One side of the local-SGD pair: train the registry LeNet on a
+    data-axis mesh for ``iters`` steps under ``mode``, measure the
+    effective per-step collective bytes off the EXACT compiled programs
+    that ran (the scan executable; plus the averaging executable,
+    amortized over H, for the local leg), and record the achieved
+    loss."""
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.nn.fuse import optimize_for_tpu
+    from bigdl_tpu.parallel.train_step import TrainStep
+    from bigdl_tpu.telemetry import comms as _comms
+    from bigdl_tpu.utils.rng import RNG
+
+    build_model, build_batch, criterion, _ = _configs()["lenet_mnist"]
+    RNG.set_seed(0)
+    model = optimize_for_tpu(build_model())
+    step = TrainStep(model, criterion,
+                     optim.SGD(learning_rate=0.05, momentum=0.9),
+                     mesh=mesh, parameter_sync=mode,
+                     compute_dtype=jnp.bfloat16)
+    x, y = build_batch(batch)
+    key = jax.random.key(0)
+    # AOT first: installs the scan EXECUTABLE (not just the jit) so the
+    # comms walker below reads the exact program that ran
+    step.aot_scan(x, y, key, h if mode == "local" else iters)
+    t0 = time.perf_counter()
+    losses = []
+    if mode == "local":
+        # scan in H-step chunks with a parameter averaging between
+        # chunks — the local-SGD schedule itself (parallel/local_sync.py
+        # drives the same rhythm in the training loop)
+        for r in range(max(1, iters // h)):
+            chunk = step.run_scan(x, y, jax.random.fold_in(key, r), h)
+            losses.append(np.asarray(chunk))
+            step.average_islands()
+    else:
+        losses.append(np.asarray(step.run_scan(x, y, key, iters)))
+    wall = time.perf_counter() - t0
+    if not all(np.isfinite(c).all() for c in losses):
+        raise FloatingPointError(f"non-finite loss in local-SGD "
+                                 f"{mode} leg")
+    row = {"batch": batch, "h": h if mode == "local" else 1,
+           "sync": mode,
+           "final_loss": round(float(np.mean(losses[-1])), 6),
+           "images_per_sec": round(batch * iters / wall, 2)}
+    nbytes = float(_comms.comms_facts(step._scan_cache[1],
+                                      mesh=mesh)["bytes"])
+    if mode == "local" and step._avg_cache is not None:
+        nbytes += float(_comms.comms_facts(step._avg_cache,
+                                           mesh=mesh)["bytes"]) / h
+    row["comms_bytes"] = nbytes
+    return row
+
+
+def run_local_sgd_pair(iters, h=None):
+    """The local-SGD evidence pair (docs/fault_tolerance.md "Straggler
+    tolerance"): the same registry model trained synchronously and with
+    H local steps between averagings on a 2-device data mesh.  The
+    ``local_sgd_sync`` / ``local_sgd_local`` rows ride the artifact's
+    ``configs`` table, so ``--diff-against`` gates BOTH sides of the
+    trade: ``.comms_bytes`` (the ≈H× reduction must not erode) and
+    ``.final_loss`` (H=10^6 would zero the comms and junk the model)."""
+    from bigdl_tpu.parallel.mesh import make_mesh
+
+    h = int(h or os.environ.get("BENCH_LOCAL_SGD_H", "8"))
+    if len(jax.devices()) < 2:
+        raise RuntimeError("local-SGD pair needs >= 2 devices")
+    mesh = make_mesh((2,), ("data",))
+    iters = max(iters, 2 * h)
+    return {
+        "local_sgd_sync": _local_sgd_leg("allreduce", h, iters, mesh),
+        "local_sgd_local": _local_sgd_leg("local", h, iters, mesh),
+    }
+
+
 #: inference configs for the int8-vs-bf16 comparison (the bigquant
 #: capability's headline claim: int8 doubles MXU throughput on v5e —
 #: 394 TOPS int8 vs 197 TFLOP/s bf16; nn/quantized.py)
@@ -853,6 +928,21 @@ def _sweep():
         except Exception as e:  # noqa: BLE001 — one config must not sink the rest
             results[name] = {"error": f"{type(e).__name__}: {e}"}
         print(f"# {name}: {results[name]}", file=sys.stderr, flush=True)
+        _last_progress[0] = time.monotonic()
+
+    # local-SGD comms/convergence pair: on for the full sweep whenever
+    # a 2-device data mesh is possible, opt-in/out via BENCH_LOCAL_SGD
+    want_ls = os.environ.get("BENCH_LOCAL_SGD")
+    if want_ls == "1" or (want_ls != "0" and not only
+                          and len(jax.devices()) >= 2):
+        try:
+            results.update(run_local_sgd_pair(iters))
+        except Exception as e:  # noqa: BLE001 — one leg must not sink the sweep
+            results["local_sgd_local"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        for n in ("local_sgd_sync", "local_sgd_local"):
+            if n in results:
+                print(f"# {n}: {results[n]}", file=sys.stderr, flush=True)
         _last_progress[0] = time.monotonic()
 
     # int8-vs-bf16 inference table: on for the full sweep (the driver's

@@ -12,17 +12,24 @@ each announced with a ``fault/injected`` telemetry instant so the run
 log and the flight-recorder ring carry the ground truth a test (or a
 postmortem) asserts against.
 
-Plan syntax — comma-separated ``kind[@step][:pP]`` specs::
+Plan syntax — comma-separated ``kind[@step][:pP][:ms]`` specs::
 
-    BIGDL_FAULTS="crash@12,nan_grads@30,wedge@45,kill_worker@20:p1,torn_ckpt,data_err@7"
+    BIGDL_FAULTS="crash@12,nan_grads@30,wedge@45,kill_worker@20:p1,torn_ckpt,data_err@7,straggle@4:p1:250"
 
 - ``kind`` — one of :data:`KINDS` (below);
 - ``@step`` — the 1-based training iteration (for ``data_err``: the
   1-based batch fetch; for ``torn_ckpt``: the first checkpoint written
-  at ``neval >= step``).  Omitted = the first opportunity;
+  at ``neval >= step``; for ``straggle``: the first slowed fetch — the
+  slowdown then persists for the rest of the run).  Omitted = the first
+  opportunity;
 - ``:pP`` — restrict to process index ``P`` (multihost); omitted = the
   fault fires on every process (SPMD-consistent, which is what a
-  slice-wide event like preemption looks like).
+  slice-wide event like preemption looks like);
+- ``:ms`` — ``straggle`` only (and required for it): the per-batch
+  delay in milliseconds.  Unlike every other kind, ``straggle`` is not
+  exactly-once — a slow host stays slow, so every data fetch from
+  ``@step`` on is delayed; only the ``fault/injected`` announcement
+  fires once.
 
 | kind          | injection point                  | exercises            |
 |---------------|----------------------------------|----------------------|
@@ -36,6 +43,7 @@ Plan syntax — comma-separated ``kind[@step][:pP]`` specs::
 | ``peer_kill`` | Optimizer loop (SIGKILL self)    | collective watchdog + supervised restart |
 | ``peer_wedge``| inside the iteration (no straggler rescue needed) | peer-heartbeat deadline |
 | ``commit_crash``| cluster commit barrier (post-write, pre-ack) | manifest-capped restore (no mixed steps) |
+| ``straggle``  | dataset fetch (persistent delay) | fleet blame + bounded-staleness shed (parallel/local_sync.py) |
 
 Permanent capacity loss is modeled by KEEPING the plan across supervised
 restarts (``supervise --keep-faults``): a ``peer_kill@step:pP`` then
@@ -74,7 +82,7 @@ log = logging.getLogger("bigdl_tpu.faults")
 #: aimed at the cluster watchdog + commit barrier (parallel/cluster.py)
 KINDS = ("crash", "wedge", "kill_worker", "preempt", "nan_grads",
          "data_err", "torn_ckpt", "peer_kill", "peer_wedge",
-         "commit_crash")
+         "commit_crash", "straggle")
 
 #: kinds polled by the Optimizer iteration loop
 _ITERATION_KINDS = ("crash", "wedge", "kill_worker", "preempt",
@@ -85,7 +93,7 @@ _ITERATION_KINDS = ("crash", "wedge", "kill_worker", "preempt",
 WEDGE_SLEEP_S = 3600.0
 
 _SPEC_RE = re.compile(r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?"
-                      r"(?::p(?P<proc>\d+))?$")
+                      r"(?::p(?P<proc>\d+))?(?::(?P<ms>\d+))?$")
 
 
 class InjectedFault(RuntimeError):
@@ -99,6 +107,7 @@ class FaultSpec:
     kind: str
     step: Optional[int] = None     # None = first opportunity
     process: Optional[int] = None  # None = every process
+    ms: Optional[int] = None       # straggle only: per-fetch delay
     fired: bool = False
     spec: str = ""                 # original text, for logs
 
@@ -142,12 +151,23 @@ class FaultPlan:
             m = _SPEC_RE.match(raw)
             if m is None or m.group("kind") not in KINDS:
                 raise ValueError(
-                    f"bad fault spec {raw!r} (want kind[@step][:pP] with "
-                    f"kind in {KINDS})")
+                    f"bad fault spec {raw!r} (want kind[@step][:pP][:ms] "
+                    f"with kind in {KINDS})")
+            kind = m.group("kind")
+            ms = int(m.group("ms")) if m.group("ms") else None
+            if kind == "straggle" and ms is None:
+                raise ValueError(
+                    f"bad fault spec {raw!r}: straggle needs a delay — "
+                    f"straggle[@step][:pP]:ms (e.g. straggle@4:p1:250)")
+            if kind != "straggle" and ms is not None:
+                raise ValueError(
+                    f"bad fault spec {raw!r}: only straggle takes a "
+                    f":ms delay")
             specs.append(FaultSpec(
-                kind=m.group("kind"),
+                kind=kind,
                 step=int(m.group("step")) if m.group("step") else None,
                 process=int(m.group("proc")) if m.group("proc") else None,
+                ms=ms,
                 spec=raw))
         return cls(specs, seed=seed)
 
@@ -232,12 +252,40 @@ class FaultPlan:
         self._announce(spec, step, "grads")
         return float("nan")
 
+    def straggle_sleep(self, fetch: int) -> float:
+        """Seconds the ``fetch``-th batch fetch (1-based) must stall on
+        this process, per the plan's ``straggle`` specs.  NOT
+        exactly-once: a slow host stays slow, so every fetch at-or-after
+        the spec's step is delayed (max over matching specs); ``fired``
+        gates only the one-time ``fault/injected`` announcement."""
+        pidx = self._process_index()
+        delay = 0.0
+        announce: List[FaultSpec] = []
+        with self._lock:
+            for s in self.specs:
+                if s.kind != "straggle":
+                    continue
+                if s.process is not None and s.process != pidx:
+                    continue
+                if s.step is not None and fetch < s.step:
+                    continue
+                delay = max(delay, (s.ms or 0) / 1000.0)
+                if not s.fired:
+                    s.fired = True
+                    announce.append(s)
+        for s in announce:
+            self._announce(s, fetch, "data")
+        return delay
+
     def wrap_data_iter(self, it: Iterator) -> Iterator:
         """Wrap the dataset batch iterator: the Nth fetch (1-based,
         process-wide across run attempts) raises :class:`InjectedFault`
         on whatever thread performs it — under prefetch, the producer
-        thread, exercising the error relay into the retry loop."""
-        if not self.has("data_err"):
+        thread, exercising the error relay into the retry loop.  A
+        ``straggle`` spec instead SLEEPS on that thread from its step
+        on, so the delay lands inside the ``data_wait`` span the fleet
+        blame attributes (telemetry/fleet.py)."""
+        if not (self.has("data_err") or self.has("straggle")):
             return it
 
         def gen():
@@ -249,6 +297,9 @@ class FaultPlan:
                 if spec is not None:
                     self._announce(spec, n, "data")
                     raise InjectedFault(f"injected data error at fetch {n}")
+                delay = self.straggle_sleep(n)
+                if delay > 0:
+                    time.sleep(delay)
                 yield batch
 
         return gen()

@@ -690,9 +690,10 @@ def main(argv=None) -> None:
                          "shard over (default: all local devices for "
                          "--comms, single device for --memory)")
     at.add_argument("--sync", default="allreduce",
-                    choices=("allreduce", "sharded", "fsdp"),
+                    choices=("allreduce", "sharded", "fsdp", "local"),
                     help="(--comms/--memory) parameter_sync mode to "
-                         "compile with")
+                         "compile with (local = local-SGD islands, "
+                         "parallel/local_sync.py)")
     at.add_argument("--sparse", default=None,
                     choices=("off", "auto", "on"),
                     help="(--comms) override BIGDL_SPARSE for this "

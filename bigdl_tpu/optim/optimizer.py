@@ -372,9 +372,13 @@ class Optimizer:
 
     def set_parameter_sync(self, mode: str) -> "Optimizer":
         """'allreduce', 'sharded' (ZeRO-1: optimizer state over the data
-        axis), or 'fsdp' (ZeRO-3: parameters too — no whole replica per
-        device)."""
-        if mode not in ("allreduce", "sharded", "fsdp"):
+        axis), 'fsdp' (ZeRO-3: parameters too — no whole replica per
+        device), or 'local' (local SGD: every data-axis device trains
+        its own island, parameters average every ``BIGDL_LOCAL_SYNC_H``
+        steps under a bounded-staleness barrier —
+        parallel/local_sync.py, docs/fault_tolerance.md "Straggler
+        tolerance")."""
+        if mode not in ("allreduce", "sharded", "fsdp", "local"):
             raise ValueError(f"unknown parameter_sync mode {mode!r}")
         self.parameter_sync = mode
         return self
@@ -1309,6 +1313,11 @@ class Optimizer:
         tele = telemetry.get()
         tele_base = tele.depth() if tele else 0
         cluster_svc = _cluster.get()
+        local_sync = None
+        if self.parameter_sync == "local":
+            from bigdl_tpu.parallel.local_sync import LocalSyncDriver
+
+            local_sync = LocalSyncDriver(step, cluster=cluster_svc)
         try:
             while not self.end_when(self.state):
                 # peer heartbeat FIRST (parallel/cluster.py): a fault
@@ -1395,6 +1404,12 @@ class Optimizer:
                     # watchdog (the first completed step ends the
                     # compile exemption)
                     cluster_svc.beat(self.state["neval"], done=True)
+                if local_sync is not None:
+                    # every H steps: average the islands under the
+                    # bounded-staleness barrier — may SHED a peer stuck
+                    # ≥ S rounds behind, or exit this process (43) if
+                    # the survivors shed US (parallel/local_sync.py)
+                    local_sync.on_step(self.state["neval"])
                 records_this_epoch += n
                 self.state["records"] = records_this_epoch
                 self.metrics.add("data time", t_data - t_start)
@@ -1521,6 +1536,10 @@ class Optimizer:
             # an in-flight capture is closed (valid trace), a merely
             # armed one cancelled — the control is reusable next run
             profile_ctl.abort()
+        if local_sync is not None:
+            # the run's final params are the ISLAND MEAN, not whatever
+            # island this process happened to train last
+            local_sync.finalize(self.state["neval"])
         step.sync_to_model()
         self._join_checkpoint_write()  # run ends with all writes landed
         log.info(self.metrics.summary())

@@ -24,7 +24,7 @@ real fleets — with no new network surface beside the gloo mesh:
 1. **Peer heartbeat** (:class:`HeartbeatPublisher`): each process
    atomically rewrites ``heartbeat.p<idx>.json`` with a MONOTONIC step
    counter + wall timestamp + status (``running/done/preempted/
-   failed``) at iteration boundaries (throttled to
+   failed/shed``) at iteration boundaries (throttled to
    ``BIGDL_HEARTBEAT_INTERVAL``).  No background writer thread: a
    heartbeat certifies *progress*, not mere process existence — a
    wedged process must look wedged.
@@ -229,6 +229,10 @@ class ClusterMonitor:
         self._lock = threading.Lock()
         self._lost: Dict[int, str] = {}     # peer -> reason
         self._seen: Dict[int, Dict] = {}    # peer -> last fresh beat
+        #: peers the bounded-staleness barrier SHED
+        #: (parallel/local_sync.py): excused from the deadline — a shed
+        #: host going silent is the expected outcome, not a loss
+        self._excused: Dict[int, str] = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ClusterMonitor":
@@ -250,6 +254,18 @@ class ClusterMonitor:
     def disarm(self) -> None:
         self._armed.clear()
 
+    def excuse(self, peer: int, reason: str) -> None:
+        """Exempt ``peer`` from the watchdog deadline — the
+        bounded-staleness barrier shed it, so its silence (or its exit)
+        is the planned outcome, never a cluster loss."""
+        with self._lock:
+            self._excused[int(peer)] = reason
+            self._lost.pop(int(peer), None)
+
+    def excused(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._excused)
+
     # -- state ---------------------------------------------------------------
     def degraded(self) -> bool:
         with self._lock:
@@ -261,6 +277,7 @@ class ClusterMonitor:
         table: Dict[str, Dict[str, Any]] = {}
         with self._lock:
             lost = dict(self._lost)
+            excused = dict(self._excused)
             seen = {p: dict(d) for p, d in self._seen.items()}
         for p in range(self.process_count):
             beat = seen.get(p) or self._read_peer(p)
@@ -275,6 +292,8 @@ class ClusterMonitor:
                            age_s=round(now - float(beat.get("ts", now)), 3))
             if p in lost:
                 row["lost"] = lost[p]
+            if p in excused:
+                row["excused"] = excused[p]
             table[f"p{p}"] = row
         return table
 
@@ -306,6 +325,10 @@ class ClusterMonitor:
         for p in range(self.process_count):
             if p == self.process_index:
                 continue
+            with self._lock:
+                if p in self._excused:
+                    self._lost.pop(p, None)
+                    continue
             beat = self._read_peer(p)
             if beat is None:
                 continue
@@ -315,7 +338,10 @@ class ClusterMonitor:
             with self._lock:
                 self._seen[p] = beat
             status = beat.get("status", "running")
-            if status in ("done", "preempted"):
+            if status in ("done", "preempted", "shed"):
+                # shed = the staleness barrier voted this host out and
+                # it exited on purpose (parallel/local_sync.py) — like
+                # done/preempted, never a loss
                 with self._lock:
                     self._lost.pop(p, None)
                 continue
@@ -423,6 +449,12 @@ class ClusterService:
     def degraded(self) -> bool:
         return self.monitor.degraded()
 
+    def excuse_peer(self, peer: int, reason: str) -> None:
+        """Excuse a SHED peer cluster-wide on this process: the
+        watchdog stops holding it to the deadline and the commit
+        barrier stops waiting for its acks."""
+        self.monitor.excuse(peer, reason)
+
     # -- coordinated commit (two-phase) --------------------------------------
     def _ack_path(self, ckpt_dir: str, p: int, step: int) -> str:
         return File.join(ckpt_dir, f"commit.p{p}.{step}.json")
@@ -458,7 +490,11 @@ class ClusterService:
             return True
         budget = float(timeout if timeout is not None else self.deadline)
         deadline = time.time() + budget
-        missing = list(range(1, self.process_count))
+        # a shed peer will never ack again — waiting for it would turn
+        # every post-shed checkpoint into a barrier timeout
+        excused = set(self.monitor.excused())
+        missing = [p for p in range(1, self.process_count)
+                   if p not in excused]
         while missing:
             missing = [p for p in missing if not File.exists(
                 self._ack_path(ckpt_dir, p, step))]
@@ -563,13 +599,63 @@ def deactivate(status: str = "done") -> None:
 
 # -- the supervisor ----------------------------------------------------------
 def _free_port() -> int:
+    """A coordinator port with the two races that made the
+    multi-process e2es flaky closed: (a) two rigs running bind(0)
+    concurrently could be handed the SAME port in the window between
+    close() and the worker's own bind — allocation is serialized under
+    a cross-process flock; (b) a port could be re-issued seconds after
+    a previous cluster released it, colliding with its TIME_WAIT
+    sockets — a ledger of recently issued ports skips them for 30 s."""
     import socket
+    import tempfile
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    try:
+        import fcntl
+    except ImportError:  # non-posix: fall back to the bare bind(0)
+        fcntl = None
+    base = os.path.join(tempfile.gettempdir(),
+                        f"bigdl_ports_{os.getuid()}"
+                        if hasattr(os, "getuid") else "bigdl_ports")
+    lock = None
+    if fcntl is not None:
+        try:
+            lock = open(base + ".lock", "a")
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except OSError:
+            lock = None
+    try:
+        now = time.time()
+        recent: Dict[str, float] = {}
+        try:
+            with open(base + ".json") as fh:
+                recent = {k: float(v) for k, v in json.load(fh).items()}
+        except (OSError, ValueError):
+            pass
+        recent = {k: t for k, t in recent.items() if now - t < 30.0}
+        port = 0
+        for _ in range(64):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            if str(port) not in recent:
+                break
+        recent[str(port)] = now
+        try:
+            tmp = f"{base}.{os.getpid()}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(recent, fh)
+            os.replace(tmp, base + ".json")
+        except OSError:
+            pass
+        return port
+    finally:
+        if lock is not None:
+            try:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            lock.close()
 
 
 class Supervisor:
@@ -809,6 +895,25 @@ class Supervisor:
         return retry_backoff_s(self.restarts)
 
     # -- capacity-aware width (docs/fault_tolerance.md "Elastic recovery") ---
+    def _shed_slots(self) -> frozenset:
+        """Slots the bounded-staleness barrier SHED this incarnation
+        (``shed.p<idx>.json`` markers in the incarnation's cluster dir,
+        written by parallel/local_sync.py before the survivors excuse
+        the peer).  A shed slot's exit — 43 on its own, or killed in
+        the drain — is a planned departure, never a casualty."""
+        inc = os.path.join(self.cluster_dir, f"inc{self.incarnation}")
+        shed = set()
+        try:
+            for name in os.listdir(inc):
+                if name.startswith("shed.p") and name.endswith(".json"):
+                    try:
+                        shed.add(int(name[len("shed.p"):-len(".json")]))
+                    except ValueError:
+                        pass
+        except OSError:
+            pass
+        return frozenset(shed)
+
     def _casualties(self, codes: Sequence[int]) -> frozenset:
         """Slot indices that SEEDED the incarnation's failure: exits
         that are neither clean (0), a watchdog peer-loss abort
@@ -839,6 +944,26 @@ class Supervisor:
         if self.min_nprocs is None:
             return
         cas = self._casualties(codes)
+        # a shed verdict is an AFFIRMATIVE "this host is not coming
+        # back" from the staleness barrier — shrink immediately instead
+        # of waiting for the two-round casualty signature
+        shed = self._shed_slots()
+        if shed and self.min_nprocs < self.nprocs \
+                and self.nprocs >= self.declared_nprocs:
+            missing = ",".join(f"p{i}" for i in sorted(shed))
+            log.warning(
+                f"[Supervisor] peer slot(s) {missing} were SHED by the "
+                f"staleness barrier and the incarnation still failed; "
+                f"relaunching DEGRADED at --min-n {self.min_nprocs}")
+            telemetry.instant("cluster/reshard", source="supervisor",
+                              from_n=self.nprocs, to_n=self.min_nprocs,
+                              declared_n=self.declared_nprocs,
+                              missing=sorted(shed),
+                              incarnation=self.incarnation,
+                              reason="shed")
+            self.nprocs = self.min_nprocs
+            self._last_casualties = frozenset()
+            return
         if self.nprocs < self.declared_nprocs:
             log.warning(
                 f"[Supervisor] degraded incarnation "
@@ -903,6 +1028,20 @@ class Supervisor:
                     log.info(f"[Supervisor] cluster completed cleanly "
                              f"after {self.restarts} restart(s)"
                              f"{degraded}")
+                    return 0
+                # clean-with-shed: every nonzero exit belongs to a slot
+                # the staleness barrier shed on purpose, and at least
+                # one survivor finished the run — the cluster COMPLETED
+                # (degraded), it did not fail
+                shed = self._shed_slots()
+                if any(c == 0 for c in codes) and all(
+                        c == 0 or i in shed
+                        for i, c in enumerate(codes)):
+                    gone = ",".join(f"p{i}" for i in sorted(
+                        i for i, c in enumerate(codes) if c != 0))
+                    log.info(f"[Supervisor] cluster completed with shed "
+                             f"host(s) {gone} ({summary}) — survivors "
+                             f"finished the run without them")
                     return 0
                 self.restarts += 1
                 if self.restarts > self.max_restarts:

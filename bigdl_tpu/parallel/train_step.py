@@ -104,11 +104,17 @@ class TrainStep:
     """Build and run the compiled train step.
 
     ``parameter_sync``: 'allreduce' (plain DP), 'sharded' (ZeRO-1: shard
-    optimizer state over the data axis), or 'fsdp' (ZeRO-3: shard the
+    optimizer state over the data axis), 'fsdp' (ZeRO-3: shard the
     PARAMETERS themselves over the data axis too — no device holds a
     whole replica; XLA all-gathers each weight at use and lowers the
     gradient collective to reduce-scatter.  Pure GSPMD: the sharding
-    annotations change, the step math doesn't).
+    annotations change, the step math doesn't), or 'local' (local SGD,
+    docs/fault_tolerance.md "Straggler tolerance": every device along
+    the data axis trains its OWN island — params/opt-state/buffers gain
+    a leading island axis sharded over ``data`` and the step runs under
+    ``vmap``, so the compiled program carries ZERO cross-island
+    collectives; islands re-converge only when the driver calls
+    :meth:`average_islands` every H steps, parallel/local_sync.py).
     ``gradient_compression``: None or 'bf16' (reference truncation
     semantics).
     ``compute_dtype``: e.g. jnp.bfloat16 to run fwd/bwd in bf16 with f32
@@ -142,11 +148,11 @@ class TrainStep:
         self.criterion = criterion
         self.optim = optim_method
         self.mesh = mesh
-        if parameter_sync not in ("allreduce", "sharded", "fsdp"):
+        if parameter_sync not in ("allreduce", "sharded", "fsdp", "local"):
             # validate where the mode is CONSUMED: a typo must not
             # silently degrade to replicated allreduce
             raise ValueError(f"unknown parameter_sync {parameter_sync!r} "
-                             f"(allreduce | sharded | fsdp)")
+                             f"(allreduce | sharded | fsdp | local)")
         self.parameter_sync = parameter_sync
         self.gradient_compression = gradient_compression
         self.compute_dtype = compute_dtype
@@ -195,6 +201,19 @@ class TrainStep:
             if not (lo <= 0.0 <= hi):
                 self._sparse_tables = {}
         self._sparse_stats = None
+        if parameter_sync == "local":
+            # local-SGD islands (parallel/local_sync.py): every state
+            # leaf gains a leading island axis and the step runs under
+            # vmap with NO cross-island comms, so sharding rules and the
+            # sparse row sync (both collective machinery) cannot apply
+            if extra_sharding_rules is not None:
+                raise ValueError("parameter_sync='local' does not "
+                                 "compose with extra_sharding_rules")
+            if len(self.batch_axes) != 1:
+                raise ValueError("parameter_sync='local' needs exactly "
+                                 "one batch axis")
+            self._sparse_tables = {}
+        self._avg_cache = None
         self._compiled = None
         self._scan_cache = None
         self._place_initial()
@@ -270,6 +289,14 @@ class TrainStep:
         return jax.tree_util.tree_map_with_path(leaf, opt_state)
 
     def _place_initial(self):
+        if self.parameter_sync == "local":
+            self.params = {k: self._stack_island(v)
+                           for k, v in self.params.items()}
+            self.buffers = {k: self._stack_island(v)
+                            for k, v in self.buffers.items()}
+            self.opt_state = jax.tree.map(self._stack_island,
+                                          self.opt_state)
+            return
         if self.mesh is None:
             return
         self.params = {k: jax.device_put(v, self._param_sharding(k, v))
@@ -280,8 +307,139 @@ class TrainStep:
             jax.device_put, self.opt_state,
             self._opt_state_shardings(self.opt_state))
 
+    # -- local-SGD islands (parameter_sync='local') ------------------------
+    def island_count(self) -> int:
+        """Islands = devices along the batch axis (1 off-mesh)."""
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape.get(self._zero_axis(), 1))
+
+    def _island_sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(
+            self.mesh, P(self._zero_axis(), *([None] * (ndim - 1))))
+
+    def _stack_island(self, v):
+        """Replicate one (unstacked) leaf into the stacked island layout:
+        leading axis = island count, sharded over the batch axis so each
+        device owns its own island's copy.  Multi-process: built from
+        process-local rows — no collective, which is what lets the
+        survivors rebuild state after a peer is shed."""
+        a = np.asarray(v)
+        n = self.island_count()
+        if self.mesh is None:
+            return jnp.broadcast_to(jnp.asarray(a), (n,) + a.shape)
+        sharding = self._island_sharding(a.ndim + 1)
+        nproc = mesh_process_count(self.mesh)
+        if nproc > 1:
+            local = np.ascontiguousarray(
+                np.broadcast_to(a, (max(1, n // nproc),) + a.shape))
+            return jax.make_array_from_process_local_data(
+                sharding, local, (n,) + a.shape)
+        return jax.device_put(
+            np.ascontiguousarray(np.broadcast_to(a, (n,) + a.shape)),
+            sharding)
+
+    def _island_rows(self, stacked) -> np.ndarray:
+        """This process's islands of one stacked leaf, as a host array
+        with the island axis leading (all islands on a single host)."""
+        shards = getattr(stacked, "addressable_shards", None)
+        if not shards:
+            return np.asarray(stacked)
+        return np.concatenate([np.asarray(s.data) for s in shards],
+                              axis=0)
+
+    def island_mean_host(self, tree) -> Dict[str, np.ndarray]:
+        """Host-side mean over this process's ADDRESSABLE islands — no
+        collective, so it stays safe after peers desynchronize or are
+        shed (the multi-process averaging path and the local-mode
+        ``sync_to_model`` both build on it)."""
+        out = {}
+        for k, v in tree.items():
+            rows = self._island_rows(v)
+            if np.issubdtype(rows.dtype, np.floating):
+                out[k] = rows.mean(axis=0).astype(rows.dtype)
+            else:
+                out[k] = rows[0]  # counters: islands agree by design
+        return out
+
+    def load_island_state(self, params: Dict[str, np.ndarray],
+                          buffers: Optional[Dict[str, np.ndarray]] = None
+                          ) -> None:
+        """Overwrite every LOCAL island with the given (unstacked)
+        state — the write-back half of a cross-process averaging round.
+        Optimizer state intentionally stays per-island (local SGD
+        averages parameters, not moments)."""
+        self.params = {
+            k: self._stack_island(np.asarray(params[k]).astype(
+                self._island_rows(v).dtype))
+            if k in params else v
+            for k, v in self.params.items()}
+        if buffers:
+            self.buffers = {
+                k: self._stack_island(np.asarray(buffers[k]).astype(
+                    self._island_rows(v).dtype))
+                if k in buffers else v
+                for k, v in self.buffers.items()}
+
+    def _fold_island_health(self, health) -> np.ndarray:
+        """Aggregate the stacked (islands, 5) health probe into the one
+        5-vector the policy reads: norms combine as sqrt-of-sum-of-
+        squares, nonfinite counts sum.  Host-side over addressable
+        islands — each process judges its own islands."""
+        rows = self._island_rows(health).astype(np.float64)
+        norms = np.sqrt(np.sum(rows[:, :3] ** 2, axis=0))
+        bads = np.sum(rows[:, 3:], axis=0)
+        return np.concatenate([norms, bads]).astype(np.float32)
+
+    def _avg_fn(self):
+        """The in-graph island averaging program (single-process path):
+        mean over the island axis + broadcast back — the ONE collective
+        local mode retains, paid every H steps instead of every step
+        (its measured bytes are the ``sync/average`` event's payload and
+        the bench leg's amortized comms_bytes)."""
+        mesh = self.mesh
+
+        def mean_bcast(a):
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                return a
+            m = jnp.mean(a, axis=0, keepdims=True)
+            out = jnp.broadcast_to(m, a.shape).astype(a.dtype)
+            if mesh is not None:
+                out = jax.lax.with_sharding_constraint(
+                    out, self._island_sharding(a.ndim))
+            return out
+
+        def avg(params, buffers):
+            return (jax.tree.map(mean_bcast, params),
+                    jax.tree.map(mean_bcast, buffers))
+
+        return avg
+
+    def _avg_executable(self):
+        if self._avg_cache is None:
+            lowered = jax.jit(self._avg_fn(),
+                              donate_argnums=(0, 1)).lower(
+                self.params, self.buffers)
+            self._avg_cache = lowered.compile()
+        return self._avg_cache
+
+    def average_islands(self) -> None:
+        """One parameter-averaging round across THIS process's islands,
+        in-graph (single-process local SGD; the multi-process barrier in
+        parallel/local_sync.py composes :meth:`island_mean_host` +
+        :meth:`load_island_state` over files instead — a jitted mean
+        over a cross-process axis would be exactly the blocking
+        collective the staleness barrier exists to avoid)."""
+        if self.parameter_sync != "local":
+            raise RuntimeError("average_islands needs "
+                               "parameter_sync='local'")
+        self.params, self.buffers = self._avg_executable()(
+            self.params, self.buffers)
+
     # -- the pure step -----------------------------------------------------
-    def _step_fn(self, with_health: bool = False):
+    def _step_fn(self, with_health: bool = False, local: bool = False):
         """The pure (params, opt_state, buffers, x, y, key[, grad_scale])
         -> (params, opt_state, buffers, loss[, health]) function, shared
         by the per-iteration jit and the scan-of-iterations jit.
@@ -289,12 +447,16 @@ class TrainStep:
         per-iteration path only — the scan path keeps the 4-tuple).
         The optional trailing ``grad_scale`` scalar is the fault-plan
         input (``grad_fault=True`` dispatches pass it; omitted, the
-        multiply never enters the trace)."""
+        multiply never enters the trace).  ``local`` traces the step
+        with NO mesh in scope — the single-island body the local-SGD
+        wrapper vmaps over the island axis (every sharding constraint
+        would otherwise re-introduce the collectives local mode
+        removes)."""
         model, criterion, optim = self.model, self.criterion, self.optim
         meta = self._meta
         comp = self.gradient_compression
         cdt = self.compute_dtype
-        mesh = self.mesh
+        mesh = None if local else self.mesh
         skip_nonfinite = self.skip_nonfinite
 
         from bigdl_tpu.nn.layers import embedding as _embed
@@ -621,7 +783,81 @@ class TrainStep:
 
         return scatter
 
+    def _local_step_fn(self, with_health: bool = False):
+        """The local-SGD island step: the mesh-free single-island body
+        vmapped over the leading island axis.  Same external signature
+        as :meth:`_step_fn`'s step — the driver cannot tell the modes
+        apart — but every state leaf carries the island axis, the batch
+        splits island-wise in-graph, and the per-island RNG key forks by
+        island index so islands explore distinct stochastic paths.
+
+        On a mesh the island axis is mapped with ``shard_map``, not a
+        sharding-constrained vmap.  vmap's conv batching rule folds the
+        island axis into the convolution batch/feature-group dims, and
+        the SPMD partitioner answers the island sharding riding on those
+        merged dims with per-step all-gathers of the full parameter set
+        (measured at 33x the bytes of the allreduce this mode replaces);
+        boundary sharding constraints cannot reach those interior ops.
+        shard_map makes island-locality STRUCTURAL: each batch-axis
+        shard runs the body on its own island block, so the compiled
+        program contains ZERO cross-island collectives and a
+        desynchronized (or shed) peer can never block a dispatch."""
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        inner = self._step_fn(with_health=with_health, local=True)
+        n = self.island_count()
+        mesh = self.mesh
+
+        def islands(params, opt_state, buffers, xs, ys, keys, *rest):
+            # leading axis = the islands of THIS shard (all of them
+            # when mesh-free); the fault scalar broadcasts to each
+            if rest:
+                one = lambda p, o, b, xi, yi, k: inner(p, o, b, xi, yi,
+                                                       k, rest[0])
+            else:
+                one = lambda p, o, b, xi, yi, k: inner(p, o, b, xi, yi,
+                                                       k)
+            return jax.vmap(one)(params, opt_state, buffers, xs, ys,
+                                 keys)
+
+        def many(params, opt_state, buffers, x, y, key, grad_scale=None):
+            def split(a):
+                if a.shape[0] % n:
+                    raise ValueError(
+                        f"local-SGD batch axis {a.shape[0]} not "
+                        f"divisible by {n} island(s)")
+                return a.reshape((n, a.shape[0] // n) + a.shape[1:])
+
+            xs = jax.tree.map(split, x)
+            ys = jax.tree.map(split, y)
+            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+                jnp.arange(n))
+            args = (params, opt_state, buffers, xs, ys, keys)
+            if grad_scale is not None:
+                args += (grad_scale,)
+            if mesh is None:
+                return islands(*args)
+            try:  # jax >= 0.6 exports shard_map at top level
+                from jax import shard_map as _sm
+                smap = partial(_sm, check_vma=False)
+            except ImportError:  # this jaxlib (0.4.x): experimental
+                from jax.experimental.shard_map import shard_map as _sm
+                smap = partial(_sm, check_rep=False)
+            isl = P(self._zero_axis())
+            in_specs = (isl,) * 6
+            if grad_scale is not None:
+                in_specs += (P(),)  # the fault scalar is replicated
+            return smap(islands, mesh=mesh, in_specs=in_specs,
+                        out_specs=isl)(*args)
+
+        return many
+
     def _build(self):
+        if self.parameter_sync == "local":
+            return jax.jit(self._local_step_fn(
+                with_health=self.health_probe), donate_argnums=(0, 1, 2))
         return jax.jit(self._step_fn(with_health=self.health_probe),
                        donate_argnums=(0, 1, 2))
 
@@ -630,8 +866,11 @@ class TrainStep:
         amortizes per-dispatch latency (remote/tunneled devices pay a full
         round-trip per dispatch) and lets XLA overlap steps.  ``stacked``:
         x/y carry a leading iteration axis (one minibatch per step);
-        otherwise the same batch repeats (the perf-harness protocol)."""
-        step = self._step_fn()
+        otherwise the same batch repeats (the perf-harness protocol).
+        In local mode the body is the vmapped island step, so the scan's
+        per-iteration losses carry an island axis."""
+        step = self._local_step_fn() \
+            if self.parameter_sync == "local" else self._step_fn()
 
         def many(params, opt_state, buffers, x, y, key):
             def body(carry, it):
@@ -685,6 +924,13 @@ class TrainStep:
         self._dispatch_observed = None
         if self._compiled is None:
             self._compiled = self._build()
+        if self.parameter_sync == "local":
+            # the driver may insert UNSTACKED scalars into opt_state
+            # mid-run (the epoch counter at epoch boundaries); the
+            # vmapped step needs every leaf to carry the island axis
+            self.opt_state = jax.tree.map(
+                lambda a: self._stack_island(a)
+                if getattr(a, "ndim", 0) == 0 else a, self.opt_state)
         tracer = _telemetry.get()
         before = _jit_cache_size(self._compiled) if tracer else None
         t0 = time.perf_counter()
@@ -706,6 +952,15 @@ class TrainStep:
              self.last_health) = out
         else:
             self.params, self.opt_state, self.buffers, loss = out
+        if self.parameter_sync == "local":
+            # stacked-island outputs: fold host-side over the
+            # ADDRESSABLE islands only — an in-graph cross-island
+            # reduce would be the collective local mode exists to
+            # remove (and would block on a shed peer)
+            if self.health_probe and self.last_health is not None:
+                self.last_health = self._fold_island_health(
+                    self.last_health)
+            loss = self._island_rows(loss).mean()
         if tracer is not None:
             first = _note_compile(tracer, self, kind, before,
                                   t0, self._compiled)
@@ -1029,6 +1284,12 @@ class TrainStep:
         this — it compiles to a collective; afterwards each leaf is
         addressable everywhere (the reference's getModel reassembly
         crossing the network, ``DistriOptimizer.scala:689-719``)."""
+        if self.parameter_sync == "local":
+            # local mode: the stacked leaves never replicate — the
+            # jitted gather would be a cross-process collective that
+            # hangs once a peer is shed.  The island mean over the
+            # ADDRESSABLE islands is the local-SGD consensus view.
+            return self.island_mean_host(tree)
         if self.mesh is not None and mesh_process_count(self.mesh) > 1:
             tree = jax.jit(lambda t: t,
                            out_shardings=replicated(self.mesh))(tree)
@@ -1039,6 +1300,11 @@ class TrainStep:
         reference's getModel reassembly, ``DistriOptimizer.scala:689-719``)."""
         from bigdl_tpu.nn.module import load_state_dict
 
+        if self.parameter_sync == "local":
+            state = {**self.island_mean_host(self.params),
+                     **self.island_mean_host(self.buffers)}
+            load_state_dict(self.model, state, strict=False)
+            return
         state = self.gather_replicated({**self.params, **self.buffers})
         load_state_dict(self.model, state, strict=False)
 

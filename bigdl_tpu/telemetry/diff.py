@@ -91,6 +91,11 @@ _RULES: List[Tuple[str, str, str]] = [
     ("comms_s", "lower", "pct"),
     (".comms_bytes", "lower", "pct"),
     (".comms_s", "lower", "pct"),
+    # achieved training loss on bench rows (bench.py --local-sgd): the
+    # convergence side of the local-SGD trade — the comms_bytes gate
+    # alone would bless H=10^6 (zero comms, junk model)
+    ("final_loss", "lower", "pct"),
+    (".final_loss", "lower", "pct"),
     # memory metrics (telemetry/memory.py): predicted per-device peak
     # HBM per run log (last memory event) and per bench row — the
     # "ZeRO-1 drops per-device optimizer HBM" gate, on the dedicated
@@ -286,7 +291,7 @@ def bench_metrics(doc: Dict[str, Any], path: str = "?") -> Dict[str, Any]:
                 out[f"{name}.{key}"] = float(row[key])
         # comms snapshot on bench rows (bench.py reads it off the scan
         # executable) — lets ZeRO/pipeline PRs gate on bytes moved
-        for key in ("comms_bytes", "comms_s"):
+        for key in ("comms_bytes", "comms_s", "final_loss"):
             if row.get(key) is not None:
                 out[f"{name}.{key}"] = float(row[key])
         # memory snapshot on bench rows (bench.py off the scan

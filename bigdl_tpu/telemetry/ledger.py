@@ -179,6 +179,15 @@ class LedgerFold:
             elif name == "straggler/timeout":
                 self.straggler_n += 1
                 self.straggler_s += _num(ev.get("budget_s"))
+            elif name == "sync/staleness":
+                # a fast host holding the local-SGD barrier open for a
+                # laggard (parallel/local_sync.py) is waiting on a slow
+                # host exactly like a straggler-guard trip — same blame
+                # column, whichever instrument caught it
+                waited = _num(ev.get("waited_s"))
+                if waited > 0:
+                    self.straggler_n += 1
+                    self.straggler_s += waited
             elif name == "cluster/drain":
                 self.drain_n += 1
                 self.drain_s += _num(ev.get("dur"))

@@ -120,6 +120,10 @@ class MetricsSink:
         # event folds into it, /status.goodput and the
         # bigdl_goodput_pct / bigdl_badput_seconds gauges read it
         self.ledger = _ledger_fold()
+        # straggler-tolerant local SGD (parallel/local_sync.py): the
+        # latest averaging round + staleness verdict + shed events —
+        # tpu_watch's sync= block
+        self.local_sync: Dict[str, Any] = {}
 
     # -- sink protocol -----------------------------------------------------
     def emit(self, event: Dict[str, Any]) -> None:
@@ -175,6 +179,24 @@ class MetricsSink:
                                    ("tables", "touched_rows",
                                     "sync_bytes", "dense_bytes",
                                     "saved_bytes") if k in event}
+                elif name == "sync/average":
+                    self.local_sync.update(
+                        {k: event[k] for k in
+                         ("round", "h", "peers", "islands", "bytes")
+                         if k in event})
+                elif name == "sync/staleness":
+                    self.local_sync.update(
+                        {k: event[k] for k in ("lag", "stale")
+                         if k in event})
+                    self.local_sync["waited_s"] = round(
+                        self.local_sync.get("waited_s", 0.0)
+                        + float(event.get("waited_s", 0.0)), 6)
+                elif name == "cluster/shed":
+                    shed = self.local_sync.setdefault("shed", [])
+                    peer = event.get("peer")
+                    if event.get("role") == "survivor" \
+                            and peer not in shed:
+                        shed.append(peer)
             elif kind == "compile":
                 self.compiles += 1
                 self.compile_s += float(event.get("dur", 0.0))
@@ -260,6 +282,7 @@ class MetricsSink:
                     "comms": dict(self.last_comms),
                     "memory": dict(self.last_memory),
                     "sparse": dict(self.sparse),
+                    "local_sync": dict(self.local_sync),
                     "goodput": self.ledger.event_fields() or {}}
 
     def openmetrics(self) -> str:

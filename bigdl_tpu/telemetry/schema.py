@@ -147,6 +147,13 @@ STREAM_NAMES = frozenset({
     # from_n/to_n/declared_n).  The fleet view folds it so hosts of a
     # legitimately-shrunk cluster are marked departed, not stalled.
     "cluster/reshard",
+    # straggler-tolerant local-SGD (bigdl_tpu/parallel/local_sync.py,
+    # docs/fault_tolerance.md "Straggler tolerance"): one instant per
+    # parameter averaging (round, step, h, bytes, dur), one per
+    # bounded-staleness barrier pass (round, waited_s, lag, stale), and
+    # the shed verdict — a peer S averaging rounds behind excused from
+    # the fleet, which continues averaging at reduced width
+    "sync/average", "sync/staleness", "cluster/shed",
     # goodput ledger inputs (telemetry/ledger.py): checkpoint-restore
     # wall (stage), preempt-resume fast-forward replay (stage), and the
     # supervisor's drain interval (instant with dur) — the measured

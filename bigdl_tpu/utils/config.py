@@ -49,6 +49,9 @@ test (or an embedding application) can inject overrides with
 | cluster_dir            | BIGDL_CLUSTER_DIR           | shared dir for peer heartbeats + commit barrier (parallel/cluster.py; unset = cluster fault tolerance off) |
 | cluster_deadline       | BIGDL_CLUSTER_DEADLINE      | peer-heartbeat deadline seconds (0 = derive from the straggler budget, else 120s) |
 | heartbeat_interval     | BIGDL_HEARTBEAT_INTERVAL    | heartbeat publish/poll throttle seconds (default 1.0) |
+| local_sync_h           | BIGDL_LOCAL_SYNC_H          | parameter_sync=local: local steps H between parameter averagings (parallel/local_sync.py; default 8) |
+| local_sync_stale       | BIGDL_LOCAL_SYNC_STALE      | parameter_sync=local: staleness bound S — a peer S averaging rounds behind is shed (default 3) |
+| local_sync_grace       | BIGDL_LOCAL_SYNC_GRACE      | parameter_sync=local: grace window seconds a peer AT the bound gets before the shed (0 = derive from the heartbeat interval) |
 | scan_layers            | BIGDL_SCAN_LAYERS           | build registry models with repeated blocks stacked into ScanLayers (docs/compile.md; default off) |
 | sparse_sync            | BIGDL_SPARSE                | sparse embedding-gradient sync (docs/sparse.md): off / auto (on when touched rows <= vocab/2) / on — numerics-exact row-sparse (indices, rows) sync instead of the dense table all-reduce |
 | trace_requests         | BIGDL_TRACE                 | per-request serving traces (telemetry/request_trace.py): span timelines, /v1/trace/<id>, blame verdicts (default on; off disables recording) |
@@ -176,6 +179,14 @@ class BigDLConfig:
     cluster_dir: Optional[str] = None
     cluster_deadline: float = 0.0
     heartbeat_interval: float = 1.0
+    # local-SGD (parallel/local_sync.py, docs/fault_tolerance.md
+    # "Straggler tolerance"): H local steps between parameter
+    # averagings; a peer whose averaging round falls S rounds behind
+    # the fleet is shed.  Read by the Optimizer when
+    # parameter_sync="local".
+    local_sync_h: int = 8
+    local_sync_stale: int = 3
+    local_sync_grace: float = 0.0
     # scan-over-layers (nn/layers/scan.py, docs/compile.md): build the
     # registry models with repeated-block runs stacked into ScanLayers
     # so XLA compiles ONE block body instead of N
@@ -254,6 +265,9 @@ class BigDLConfig:
             cluster_dir=env.get("BIGDL_CLUSTER_DIR") or None,
             cluster_deadline=_float("BIGDL_CLUSTER_DEADLINE", 0.0),
             heartbeat_interval=_float("BIGDL_HEARTBEAT_INTERVAL", 1.0),
+            local_sync_h=_int("BIGDL_LOCAL_SYNC_H", 8),
+            local_sync_stale=_int("BIGDL_LOCAL_SYNC_STALE", 3),
+            local_sync_grace=_float("BIGDL_LOCAL_SYNC_GRACE", 0.0),
             scan_layers=_truthy(env.get("BIGDL_SCAN_LAYERS")),
             sparse_sync=(env.get("BIGDL_SPARSE")
                          or "auto").strip().lower(),

@@ -23,7 +23,6 @@ checkpoint, final params equal the uninterrupted run.
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import threading
@@ -368,12 +367,10 @@ def test_sigterm_interrupts_retry_backoff(tmp_path, monkeypatch):
 
 
 # -- E2E: the distributed fault matrix on live clusters ----------------------
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+# the flock-serialized allocator with the recent-port ledger: concurrent
+# test processes (and back-to-back clusters in one test) no longer race
+# each other into the same coordinator port (deflake, ISSUE 20)
+_free_port = cluster._free_port
 
 
 def _worker_env(**extra) -> dict:
@@ -447,7 +444,10 @@ def test_peer_wedge_surviving_hosts_exit_instead_of_hanging(tmp_path):
         BIGDL_TEST_ITERS=8, BIGDL_TEST_CKPT=str(tmp_path / "ckpt"),
         BIGDL_TEST_CKPT_EVERY=2, BIGDL_FAULTS="peer_wedge@3:p1",
         BIGDL_CLUSTER_DIR=str(tmp_path / "hb"),
-        BIGDL_CLUSTER_DEADLINE=3, BIGDL_HEARTBEAT_INTERVAL=0.2,
+        # deadline 6 not 3: under a loaded CI host the first tracing
+        # step alone can stall a worker past 3 s of missed heartbeats
+        # and fire a spurious peer_lost (deflake, ISSUE 20)
+        BIGDL_CLUSTER_DEADLINE=6, BIGDL_HEARTBEAT_INTERVAL=0.2,
         BIGDL_TELEMETRY=str(tele), BIGDL_ASYNC_CHECKPOINT=0,
         BIGDL_RETRY_BACKOFF=0.05)
     codes, outs = _wait_all(procs, timeout=120)
@@ -476,7 +476,7 @@ def test_commit_crash_never_yields_mixed_step_restore(tmp_path):
     still structurally invisible — and the finished run must match an
     uninterrupted one."""
     base = dict(BIGDL_TEST_ITERS=8, BIGDL_TEST_CKPT_EVERY=2,
-                BIGDL_CLUSTER_DEADLINE=3, BIGDL_HEARTBEAT_INTERVAL=0.2,
+                BIGDL_CLUSTER_DEADLINE=6, BIGDL_HEARTBEAT_INTERVAL=0.2,
                 BIGDL_ASYNC_CHECKPOINT=0, BIGDL_RETRY_BACKOFF=0.05)
     # uninterrupted control
     un = str(tmp_path / "un.npz")
@@ -527,7 +527,7 @@ def test_supervised_peer_kill_restart_matches_uninterrupted(tmp_path):
     full cluster, auto-resume lands on the cluster-consistent step-4
     checkpoint, and the final params equal the uninterrupted run's."""
     base = dict(BIGDL_TEST_ITERS=8, BIGDL_TEST_CKPT_EVERY=4,
-                BIGDL_CLUSTER_DEADLINE=3, BIGDL_HEARTBEAT_INTERVAL=0.2,
+                BIGDL_CLUSTER_DEADLINE=6, BIGDL_HEARTBEAT_INTERVAL=0.2,
                 BIGDL_ASYNC_CHECKPOINT=0, BIGDL_RETRY_BACKOFF=0.05)
     un = str(tmp_path / "un.npz")
     codes, outs = _wait_all(_launch_cluster(
@@ -633,6 +633,57 @@ def test_supervisor_min_n_distinct_casualties_do_not_shrink(
     assert sup.width_history == [3, 3, 3]
 
 
+def test_supervisor_shed_exit_is_clean_completion(tmp_path, monkeypatch):
+    """A ``shed.p<idx>.json`` marker (the staleness barrier's verdict,
+    parallel/local_sync.py) makes that slot's exit-43 a PLANNED
+    departure: survivors finishing 0 means the cluster COMPLETED
+    (degraded) — no restart, exit 0."""
+    monkeypatch.setenv("BIGDL_RETRY_BACKOFF", "0.01")
+    body = ("import json, os, sys\n"
+            "d = os.environ['BIGDL_CLUSTER_DIR']\n"
+            "if os.environ['BIGDL_PROCESS_ID'] == '1':\n"
+            "    with open(os.path.join(d, 'shed.p1.json'), 'w') as f:\n"
+            "        json.dump({'peer': 1, 'by': 0, 'round': 3,\n"
+            "                   'lag': 2, 'stale': 2}, f)\n"
+            f"    sys.exit({cluster.EXIT_PEER_LOST})\n"
+            "sys.exit(0)\n")
+    sup = cluster.Supervisor(3, _toy_worker(body), max_restarts=2,
+                             cluster_dir=str(tmp_path / "cl"),
+                             settle_grace=5.0)
+    assert sup.run() == 0
+    assert sup.restarts == 0
+    assert sup.width_history == [3]
+    assert sup.exit_history == [[0, cluster.EXIT_PEER_LOST, 0]]
+
+
+def test_supervisor_shed_failure_shrinks_to_min_n_immediately(
+        tmp_path, monkeypatch):
+    """Shrink-then-grow-back wiring for the shed verdict: a shed marker
+    is an AFFIRMATIVE "this host is not coming back", so when the
+    incarnation still fails the supervisor relaunches DEGRADED at
+    ``--min-n`` at once — no two-round same-casualty signature needed."""
+    monkeypatch.setenv("BIGDL_RETRY_BACKOFF", "0.01")
+    body = ("import json, os, sys\n"
+            "pid = os.environ['BIGDL_PROCESS_ID']\n"
+            "d = os.environ['BIGDL_CLUSTER_DIR']\n"
+            "if os.environ['BIGDL_NUM_PROCESSES'] == '3':\n"
+            "    if pid == '1':\n"
+            "        with open(os.path.join(d, 'shed.p1.json'), 'w') "
+            "as f:\n"
+            "            json.dump({'peer': 1, 'by': 0}, f)\n"
+            f"        sys.exit({cluster.EXIT_PEER_LOST})\n"
+            "    if pid == '0':\n"
+            "        sys.exit(9)\n"
+            "sys.exit(0)\n")
+    sup = cluster.Supervisor(3, _toy_worker(body), max_restarts=3,
+                             cluster_dir=str(tmp_path / "cl"),
+                             settle_grace=5.0, min_nprocs=2)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    assert sup.width_history == [3, 2], sup.exit_history
+    assert sup.exit_history[1] == [0, 0]
+
+
 def test_supervisor_min_n_validation():
     with pytest.raises(ValueError, match="min_nprocs"):
         cluster.Supervisor(4, _toy_worker("pass"), min_nprocs=5)
@@ -651,7 +702,7 @@ def test_supervised_peer_kill_min_n_recovers_at_reduced_width(tmp_path):
     and the finished run's params equal an uninterrupted run's, with
     zero manual intervention."""
     base = dict(BIGDL_TEST_ITERS=8, BIGDL_TEST_CKPT_EVERY=4,
-                BIGDL_CLUSTER_DEADLINE=3, BIGDL_HEARTBEAT_INTERVAL=0.2,
+                BIGDL_CLUSTER_DEADLINE=6, BIGDL_HEARTBEAT_INTERVAL=0.2,
                 BIGDL_ASYNC_CHECKPOINT=0, BIGDL_RETRY_BACKOFF=0.05)
     un = str(tmp_path / "un.npz")
     codes, outs = _wait_all(_launch_cluster(

@@ -114,6 +114,54 @@ def test_plan_rejects_bad_specs():
             faults.FaultPlan.parse(bad)
 
 
+def test_plan_parse_straggle_requires_delay():
+    plan = faults.FaultPlan.parse("straggle@4:p1:250")
+    s = plan.specs[0]
+    assert (s.kind, s.step, s.process, s.ms) == ("straggle", 4, 1, 250)
+    with pytest.raises(ValueError, match="straggle needs a delay"):
+        faults.FaultPlan.parse("straggle@4:p1")
+    with pytest.raises(ValueError, match="only straggle takes"):
+        faults.FaultPlan.parse("crash@3:250")
+
+
+def test_straggle_sleep_persists_and_announces_once():
+    """Unlike every other kind, ``straggle`` is NOT exactly-once: a slow
+    host stays slow, so every fetch from ``@step`` on is delayed; only
+    the ``fault/injected`` announcement fires once."""
+    plan = faults.FaultPlan.parse("straggle@3:250")
+    sink = telemetry.MemorySink()
+    with telemetry.run(sinks=[sink]):
+        assert plan.straggle_sleep(1) == 0.0
+        assert plan.straggle_sleep(2) == 0.0
+        assert plan.straggle_sleep(3) == pytest.approx(0.25)
+        assert plan.straggle_sleep(9) == pytest.approx(0.25)
+    marks = _instants(sink, "fault/injected")
+    assert len(marks) == 1 and marks[0]["fault"] == "straggle"
+    # a :pP selector for another process never slows THIS one
+    other = faults.FaultPlan.parse("straggle@1:p1:250")
+    assert other.straggle_sleep(5) == 0.0
+    # overlapping specs: the worst delay wins, not the sum
+    both = faults.FaultPlan.parse("straggle@1:100,straggle@2:50")
+    assert both.straggle_sleep(2) == pytest.approx(0.1)
+
+
+def test_straggle_delays_data_iter_in_place():
+    """The injection point: ``wrap_data_iter`` sleeps ON the fetching
+    thread, so under prefetch the delay lands inside the ``data_wait``
+    span that fleet blame attributes (telemetry/fleet.py)."""
+    import time as _time
+
+    plan = faults.FaultPlan.parse("straggle@2:60")
+    it = plan.wrap_data_iter(iter([1, 2, 3]))
+    t0 = _time.perf_counter()
+    assert next(it) == 1
+    fast = _time.perf_counter() - t0
+    t1 = _time.perf_counter()
+    assert list(it) == [2, 3]
+    slow = _time.perf_counter() - t1
+    assert slow >= 0.12 > fast
+
+
 def test_bad_plan_fails_fast_not_retried(tmp_path, monkeypatch):
     """A typo'd BIGDL_FAULTS is a CONFIG error: optimize() must surface
     it immediately, not burn the retry budget on it."""

@@ -141,6 +141,36 @@ def test_retry_backoff_killed_mid_sleep_is_trimmed_to_wall():
     _assert_conserves(r)
 
 
+def test_staleness_barrier_wait_charged_to_straggler_badput():
+    """A fast host holding the local-SGD door open for a laggard
+    (``sync/staleness`` ``waited_s`` — parallel/local_sync.py) lands in
+    the SAME ``straggler`` blame column as a straggler-guard trip, and
+    the in-step carve still caps it at the time the steps took."""
+    events = [
+        _ev("run_start", 0.0, meta={"process_index": 0}),
+        _ev("step", 1.0, step=0, dur=1.0),
+        _ev("step", 2.0, step=1, dur=1.0),
+        # the survivor waited 0.7s of those steps at the barrier; a
+        # zero-wait round must NOT count as a straggler incident
+        _ev("event", 2.0, name="sync/staleness", round=1, waited_s=0.7,
+            lag=1, stale=2, step=2),
+        _ev("event", 2.0, name="sync/staleness", round=2, waited_s=0.0,
+            lag=0, stale=2, step=4),
+        _ev("run_end", 3.0, dur=3.0),
+    ]
+    r = ledger.goodput_from_events(events)
+    assert r["badput"]["straggler"] == pytest.approx(0.7)
+    assert r["counts"]["stragglers"] == 1
+    _assert_conserves(r)
+    # mis-scaled waits can never push the carve past the step time
+    huge = list(events)
+    huge[3] = _ev("event", 2.0, name="sync/staleness", round=1,
+                  waited_s=99.0, lag=3, stale=2, step=2)
+    r2 = ledger.goodput_from_events(huge)
+    assert r2["badput"]["straggler"] <= 2.0
+    _assert_conserves(r2)
+
+
 def test_chain_stitches_gap_into_backoff_plus_restart():
     r = ledger.ledger_from_events(_incarnation_chain())
     assert r["conservation"]["ok"]

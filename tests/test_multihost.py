@@ -10,23 +10,19 @@ equivalence discipline (SURVEY §4) applied across a process boundary.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
 import pytest
 
+# the flock-serialized allocator with the recent-port ledger: two tests
+# (or two pytest workers) grabbing ports back-to-back can otherwise race
+# the same ephemeral port into both clusters (deflake, ISSUE 20)
+from bigdl_tpu.parallel.cluster import _free_port
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _worker_env(**extra) -> dict:
@@ -39,10 +35,12 @@ def _worker_env(**extra) -> dict:
 
 
 def _run_cluster(tmp_path, tag: str, nproc: int = 2, expect_out: bool = True,
-                 timeout: int = 420, **extra) -> str:
+                 timeout: int = 420, codes=None, **extra) -> str:
     """Run the worker on an ``nproc``-process cluster; return the
     coordinator's saved-params path.  ``expect_out=False`` for runs that
     legitimately end without publishing params (graceful preemption).
+    ``codes`` maps process index -> expected returncode for runs where a
+    nonzero exit IS the asserted behavior (a shed straggler exits 43).
     The generous default ``timeout`` is deliberate: these tests spin
     real jax.distributed clusters and must stay green on loaded CI
     machines (deflake budget, ISSUE 5)."""
@@ -67,8 +65,11 @@ def _run_cluster(tmp_path, tag: str, nproc: int = 2, expect_out: bool = True,
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for p, text in zip(procs, outputs):
-        assert p.returncode == 0, f"cluster worker failed:\n{text[-4000:]}"
+    for pid, (p, text) in enumerate(zip(procs, outputs)):
+        want = (codes or {}).get(pid, 0)
+        assert p.returncode == want, (
+            f"cluster worker p{pid} exited {p.returncode} "
+            f"(expected {want}):\n{text[-4000:]}")
     if expect_out:
         assert os.path.exists(out), "coordinator did not write params"
     return out
@@ -223,9 +224,10 @@ def test_two_process_preempt_resume_matches_uninterrupted(tmp_path):
 @pytest.mark.deadline(420)
 def test_two_process_fleet_observability_blames_slow_host(tmp_path):
     """The ISSUE 10 acceptance path, one live 2-process run covering the
-    whole comms/fleet stack: process 1 carries an injected 250 ms/batch
-    data-pipeline stall (a ``peer_wedge``-style slowdown that drags
-    every synchronous step), both workers write telemetry into ONE
+    whole comms/fleet stack: process 1 carries a 250 ms/batch
+    data-pipeline stall injected by the ``straggle`` fault plan (the
+    deterministic slow-host kind, bigdl_tpu/faults.py — the test-only
+    slow-host env knobs are gone), both workers write telemetry into ONE
     shared dir, and
 
     - the coordinator's live ``/status`` shows the ``fleet`` block with
@@ -241,7 +243,7 @@ def test_two_process_fleet_observability_blames_slow_host(tmp_path):
     tele.mkdir()
     _run_cluster(tmp_path, "fleet",
                  BIGDL_TEST_FLEET=1, BIGDL_TEST_ITERS=10,
-                 BIGDL_TEST_SLOW_P=1, BIGDL_TEST_SLOW_MS=250,
+                 BIGDL_FAULTS="straggle@1:p1:250",
                  BIGDL_TELEMETRY=str(tele), BIGDL_METRICS_PORT=0,
                  BIGDL_FLEET_INTERVAL="0.3")
     import glob
@@ -275,6 +277,122 @@ def test_two_process_fleet_observability_blames_slow_host(tmp_path):
     # the one-shot fleet view reaches the same verdict
     view = fleet_view(loaded)
     assert set(view["hosts"]) == {"p0", "p1"}
+    verdict = view["blame"]
+    assert verdict is not None, view
+    assert verdict["laggard"] == 1 and verdict["cause"] == "data_wait", \
+        verdict
+
+
+@pytest.mark.deadline(420)
+def test_two_process_local_sgd_sheds_straggler(tmp_path):
+    """The ISSUE 20 acceptance path — straggler-tolerant local SGD on a
+    REAL 2-process cluster: both workers train with
+    ``parameter_sync=local`` (H=4 local steps between averaging rounds,
+    staleness bound S=2), and the fault plan makes p1 a persistent
+    250 ms/fetch straggler from fetch 4 on (``straggle@4:p1:250``).
+    p1's averaging rounds fall behind; when its lag hits S and it fails
+    to catch up within the grace window, the SURVIVOR sheds it:
+
+    - p0 finishes all iterations and publishes finite, actually-trained
+      params (exit 0); p1 reads its shed marker and exits 43
+      (EXIT_PEER_LOST — the planned-departure code the supervisor
+      treats as clean);
+    - both run logs validate against the schema and carry the shed
+      protocol: ``cluster/shed`` from the survivor (role=survivor,
+      peer=1) AND from the victim (role=victim), ``sync/average``
+      rounds, and ``sync/staleness`` with the grace wait the ledger
+      charges to straggler badput;
+    - p1's final heartbeat status is ``shed`` — peers read the exit as
+      planned, like done/preempted;
+    - the fleet view blames p1 with cause ``data_wait`` — the straggle
+      delay lands in the data pipeline, exactly where the blame
+      machinery looks."""
+    import glob
+    import json
+
+    tele = tmp_path / "tele_shed"
+    tele.mkdir()
+    cluster = tmp_path / "cluster_shed"
+    cluster.mkdir()
+    base = dict(BIGDL_TEST_LOCAL_SYNC=1, BIGDL_TEST_ITERS=32,
+                BIGDL_LOCAL_SYNC_H=4, BIGDL_LOCAL_SYNC_STALE=2,
+                BIGDL_LOCAL_SYNC_GRACE="0.5",
+                BIGDL_HEARTBEAT_INTERVAL="0.2")
+    healthy = _run_cluster(
+        tmp_path, "shed_healthy",
+        BIGDL_CLUSTER_DIR=str(tmp_path / "cluster_healthy"), **base)
+    out = _run_cluster(
+        tmp_path, "shed", codes={1: 43},
+        BIGDL_FAULTS="straggle@4:p1:250",
+        BIGDL_CLUSTER_DIR=str(cluster),
+        BIGDL_TELEMETRY=str(tele), **base)
+
+    def dataset_nll(path):
+        # the worker's exact data (rng order matters) pushed through its
+        # MLP host-side: the whole-dataset loss, not one noisy batch
+        z = np.load(path)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype(np.float32)
+        y = rng.randint(0, 4, 64)
+        h = np.tanh(x @ z["0.weight"].T + z["0.bias"])
+        logits = h @ z["2.weight"].T + z["2.bias"]
+        m = logits.max(axis=1, keepdims=True)
+        logp = logits - m - np.log(
+            np.exp(logits - m).sum(axis=1, keepdims=True))
+        return float(-logp[np.arange(64), y].mean())
+
+    # the survivor's params are finite and actually trained: the run
+    # that shed its slow half must land within tolerance of the healthy
+    # 2-process run (it saw half the data from the shed point on)
+    z = np.load(out)
+    for k in z.files:
+        assert np.isfinite(z[k]).all(), f"non-finite {k}"
+    shed_nll, healthy_nll = dataset_nll(out), dataset_nll(healthy)
+    init_nll = np.log(4.0)
+    assert shed_nll < init_nll - 0.05, (shed_nll, init_nll)
+    assert shed_nll < healthy_nll + 0.15, (shed_nll, healthy_nll)
+    # the victim's heartbeat closed with the PLANNED-departure status
+    hb = json.load(open(cluster / "heartbeat.p1.json"))
+    assert hb["status"] == "shed", hb
+    # the shed marker names the survivor's verdict
+    marker = json.load(open(cluster / "shed.p1.json"))
+    assert marker["peer"] == 1 and marker["by"] == 0, marker
+    assert marker["lag"] >= marker["stale"] == 2, marker
+
+    from bigdl_tpu.telemetry import schema
+    from bigdl_tpu.telemetry.fleet import fleet_view
+
+    logs = sorted(glob.glob(str(tele / "run-*.jsonl")))
+    assert len(logs) == 2, logs
+    loaded, by_pidx = [], {}
+    for path in logs:
+        events, parse_errors = schema.read_events(path)
+        assert parse_errors == [], parse_errors
+        assert schema.validate_events(events) == [], path
+        loaded.append((path, events))
+        pidx = next(e["meta"].get("process_index") for e in events
+                    if e.get("kind") == "run_start")
+        by_pidx[pidx] = events
+
+    def named(events, name):
+        return [e for e in events if e.get("kind") == "event"
+                and e.get("name") == name]
+
+    # both sides of the shed protocol announced themselves
+    survivor = named(by_pidx[0], "cluster/shed")
+    assert survivor and survivor[-1]["role"] == "survivor" \
+        and survivor[-1]["peer"] == 1, survivor
+    victim = named(by_pidx[1], "cluster/shed")
+    assert victim and victim[-1]["role"] == "victim" \
+        and victim[-1]["peer"] == 1, victim
+    # averaging rounds ran, and the survivor paid a grace wait at least
+    # once before the shed (the wait the ledger charges to straggler)
+    assert named(by_pidx[0], "sync/average"), "no averaging rounds"
+    waits = [e for e in named(by_pidx[0], "sync/staleness")
+             if e.get("waited_s", 0) > 0]
+    assert waits, "survivor never held the door before shedding"
+    # the fleet view reaches the blame verdict the shed acted on
+    view = fleet_view(loaded)
     verdict = view["blame"]
     assert verdict is not None, view
     assert verdict["laggard"] == 1 and verdict["cause"] == "data_wait", \

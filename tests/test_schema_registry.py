@@ -70,7 +70,12 @@ PINNED = ["bigdl_tpu/faults.py", "bigdl_tpu/utils/ckpt_digest.py",
           # goodput ledger (ISSUE 18): a silent drop loses the
           # wall-time conservation contract and every goodput surface
           # (end-of-run event, CLI fold, diff/bench gates)
-          "bigdl_tpu/telemetry/ledger.py"]
+          "bigdl_tpu/telemetry/ledger.py",
+          # straggler-tolerant local SGD (ISSUE 20): the bounded-
+          # staleness barrier + shed protocol — a silent drop leaves
+          # parameter_sync=local with no cross-process exchange and no
+          # way to stop waiting for a slow host
+          "bigdl_tpu/parallel/local_sync.py"]
 
 
 def test_pinned_fault_tolerance_modules_present():

@@ -97,6 +97,11 @@ PINNED_MODULES = [
     # run-level wall-time accounting every surface folds (goodput
     # event, /status.goodput, fleet columns, diff/bench gates)
     "bigdl_tpu/telemetry/ledger.py",
+    # straggler-tolerant local SGD (ISSUE 20): losing local_sync.py
+    # silently drops the bounded-staleness barrier + shed protocol —
+    # parameter_sync=local would average islands but never exchange
+    # across processes, and a slow host would stall the fleet forever
+    "bigdl_tpu/parallel/local_sync.py",
 ]
 
 

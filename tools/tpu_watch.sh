@@ -203,6 +203,17 @@ if mem.get("peak_bytes"):
             line += "!PRESSURE"
     else:
         line += f" hbm_peak={mem['peak_bytes'] / g:.2f}G"
+# straggler-tolerant local SGD (parallel/local_sync.py): averaging
+# period, worst peer lag vs the staleness bound, cumulative barrier
+# wait, and any shed hosts — the babysitter sees "p1 is 2/3 rounds
+# behind" before the shed verdict lands
+ls_ = st.get("local_sync") or {}
+if ls_.get("h"):
+    line += f" sync=local H={ls_['h']} stale={ls_.get('lag', 0)}/{ls_.get('stale', '?')}"
+    if ls_.get("waited_s"):
+        line += f" held={ls_['waited_s']:.1f}s"
+    if ls_.get("shed"):
+        line += " shed=" + ",".join(f"p{p}" for p in ls_["shed"]) + "!"
 # fleet watcher (telemetry/fleet.py, coordinator only): host count,
 # completed-step lag, and the skew-blame verdict — "one host is slow,
 # whose fault?" answered on one line
